@@ -1,0 +1,43 @@
+"""Test environment: force an 8-device CPU platform for the whole suite.
+
+This is the TPU-native analogue of the reference's dockerized Flyte demo sandbox
+(``tests/integration/test_flyte_remote.py:36-60``): an
+``xla_force_host_platform_device_count=8`` CPU mesh stands in for a v5e-8 so
+distributed semantics (sharding, collectives, multi-chip compilation) are tested
+without TPU hardware (SURVEY.md §4).
+
+Two layers of defense, because a site shim may import jax eagerly at interpreter
+start and register remote TPU plugins whose transport can be unavailable in CI:
+
+1. env vars set before jax would normally load (fresh interpreters);
+2. if jax is already imported but backends are not yet initialized, deregister every
+   non-CPU backend factory so no remote plugin is dialed during tests.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+if "jax" in sys.modules:
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        # jax.config captured JAX_PLATFORMS at its original import; repoint it to cpu
+        jax.config.update("jax_platforms", "cpu")
+        if not _xb.backends_are_initialized():
+            for _name in list(_xb._backend_factories):
+                if _name != "cpu":
+                    _xb._backend_factories.pop(_name, None)
+    except Exception:  # noqa: BLE001 - best effort; env vars above still apply
+        pass
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))

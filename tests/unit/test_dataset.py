@@ -1,0 +1,191 @@
+"""Dataset unit tests, mirroring the reference suite (``tests/unit/test_dataset.py``)."""
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from unionml_tpu import Dataset
+from unionml_tpu.dataset import DatasetTypeSource
+from unionml_tpu.workflow import Workflow
+
+
+def make_frame_dataset(**kwargs) -> Dataset:
+    dataset = Dataset(name="ds", targets=["y"], **kwargs)
+
+    @dataset.reader
+    def reader(n: int = 50) -> pd.DataFrame:
+        rng = np.random.default_rng(0)
+        return pd.DataFrame({"a": rng.normal(size=n), "b": rng.normal(size=n), "y": rng.integers(0, 2, size=n)})
+
+    return dataset
+
+
+def test_reader_registration():
+    dataset = make_frame_dataset()
+    assert dataset._reader is not None
+    assert dataset.dataset_datatype == {"data": pd.DataFrame}
+    assert dataset.dataset_datatype_source is DatasetTypeSource.READER
+
+
+def test_reader_requires_return_annotation():
+    dataset = Dataset(name="ds")
+    with pytest.raises(TypeError, match="return type"):
+
+        @dataset.reader
+        def reader(n: int = 10):
+            return [1.0] * n
+
+
+def test_dataset_task_interface():
+    dataset = make_frame_dataset()
+    task = dataset.dataset_task()
+    assert task.name == "ds.reader" or task.name.endswith("dataset_task")
+    assert list(task.python_interface.inputs) == ["n"]
+    assert list(task.python_interface.outputs) == ["data"]
+    out = task(n=10)
+    assert isinstance(out, pd.DataFrame) and len(out) == 10
+
+
+def test_default_pipeline_get_data():
+    dataset = make_frame_dataset()
+    raw = dataset._reader(n=50)
+    data = dataset.get_data(raw)
+    assert set(data) == {"train", "test"}
+    train_features, train_targets = data["train"]
+    assert list(train_features.columns) == ["a", "b"]
+    assert list(train_targets.columns) == ["y"]
+    assert len(train_features) == 40 and len(data["test"][0]) == 10
+
+
+def test_get_data_kwargs_override():
+    dataset = make_frame_dataset()
+    raw = dataset._reader(n=50)
+    data = dataset.get_data(raw, splitter_kwargs={"test_size": 0.5})
+    assert len(data["train"][0]) == 25
+
+
+def test_default_feature_pipeline():
+    dataset = make_frame_dataset()
+    features = dataset.get_features([{"a": 1.0, "b": 2.0}])
+    assert isinstance(features, pd.DataFrame)
+    assert list(features.columns) == ["a", "b"]
+
+
+def test_custom_feature_pipeline():
+    dataset = make_frame_dataset()
+
+    @dataset.feature_loader
+    def feature_loader(raw: List[List[float]]) -> pd.DataFrame:
+        return pd.DataFrame(raw, columns=["a", "b"])
+
+    @dataset.feature_transformer
+    def feature_transformer(features: pd.DataFrame) -> pd.DataFrame:
+        return features * 2
+
+    features = dataset.get_features([[1.0, 2.0]])
+    assert features.iloc[0, 0] == 2.0 and features.iloc[0, 1] == 4.0
+
+
+def test_custom_splitter_and_parser_non_dataframe():
+    dataset = Dataset(name="ds")
+
+    @dataset.reader
+    def reader() -> Dict[str, np.ndarray]:
+        return {"x": np.arange(10.0), "y": np.arange(10.0) % 2}
+
+    Splits = Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]
+
+    @dataset.splitter
+    def splitter(data: Dict[str, np.ndarray], test_size: float, shuffle: bool, random_state: int) -> Splits:
+        n_test = int(len(data["x"]) * test_size)
+        head = {k: v[:-n_test] for k, v in data.items()}
+        tail = {k: v[-n_test:] for k, v in data.items()}
+        return head, tail
+
+    Parsed = Tuple[np.ndarray, np.ndarray]
+
+    @dataset.parser
+    def parser(data: Dict[str, np.ndarray], features: Optional[List[str]], targets: List[str]) -> Parsed:
+        return data["x"], data["y"]
+
+    data = dataset.get_data(reader())
+    assert data["train"][0].shape == (8,)
+    assert data["test"][0].shape == (2,)
+
+
+def test_custom_loader():
+    dataset = Dataset(name="ds", targets=["y"])
+
+    @dataset.reader
+    def reader() -> str:
+        return '{"a": [1.0, 2.0], "y": [0, 1]}'
+
+    @dataset.loader
+    def loader(data: str) -> pd.DataFrame:
+        import json
+
+        return pd.DataFrame(json.loads(data))
+
+    assert dataset.dataset_datatype == {"data": pd.DataFrame}
+    assert dataset.dataset_datatype_source is DatasetTypeSource.LOADER
+    data = dataset.get_data(reader())
+    assert "train" in data
+
+
+def test_dataset_task_in_plain_workflow():
+    """Compose a dataset stage inside a hand-built workflow (ref ``test_dataset.py:129``)."""
+    dataset = make_frame_dataset()
+    task = dataset.dataset_task()
+
+    wf = Workflow("custom")
+    wf.add_workflow_input("n", int)
+    node = wf.add_entity(task, n=wf.inputs["n"])
+    wf.add_workflow_output("data", node.outputs["data"])
+    out = wf(n=7)
+    assert isinstance(out, pd.DataFrame) and len(out) == 7
+
+
+def test_device_format_jax():
+    """TPU-native: parsed splits land as device arrays when device_format='jax'."""
+    import jax
+
+    dataset = make_frame_dataset(device_format="jax")
+    data = dataset.get_data(dataset._reader(n=20))
+    features, target = data["train"]
+    assert isinstance(features, jax.Array)
+    assert features.dtype == jax.numpy.float32
+    assert features.shape == (16, 2)
+
+
+def test_default_splitter_array_and_passthrough():
+    ds = Dataset(name="d")
+    arr = np.arange(20.0).reshape(10, 2)
+    train, test = ds._default_splitter(arr, test_size=0.2, shuffle=False, random_state=0)
+    assert train.shape == (8, 2) and test.shape == (2, 2)
+    (only,) = ds._default_splitter("opaque", test_size=0.2, shuffle=False, random_state=0)
+    assert only == "opaque"
+
+
+def test_from_sqlite(tmp_path):
+    import sqlite3
+
+    db = tmp_path / "data.db"
+    with sqlite3.connect(db) as conn:
+        conn.execute("CREATE TABLE points (a REAL, b REAL, y INTEGER)")
+        rng = np.random.default_rng(1)
+        rows = [(float(rng.normal()), float(rng.normal()), int(rng.integers(0, 2))) for _ in range(30)]
+        conn.executemany("INSERT INTO points VALUES (?, ?, ?)", rows)
+
+    dataset = Dataset.from_sqlite(
+        str(db),
+        "SELECT * FROM points LIMIT :limit",
+        query_params={"limit": int},
+        name="sql_ds",
+        targets=["y"],
+    )
+    raw = dataset._reader(limit=10)
+    assert isinstance(raw, pd.DataFrame) and len(raw) == 10
+    data = dataset.get_data(raw)
+    assert len(data["train"][0]) == 8

@@ -1,0 +1,232 @@
+"""Model unit tests, mirroring the reference suite (``tests/unit/test_model.py``)."""
+
+import io
+from typing import List
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.linear_model import LogisticRegression
+
+from unionml_tpu import Model, ModelArtifact
+from unionml_tpu.exceptions import ModelArtifactNotFound
+from unionml_tpu.workflow import Workflow
+
+from tests.unit.model_fixtures import make_dataset, make_sklearn_model
+
+
+def test_decorator_wiring(model):
+    assert model._trainer is not None
+    assert model._predictor is not None
+    assert model._evaluator is not None
+    assert model.model_type is LogisticRegression
+
+
+def test_train_task_interface(model):
+    task = model.train_task()
+    inputs = list(task.python_interface.inputs)
+    assert inputs[0] == "hyperparameters"
+    assert "sample_frac" not in inputs  # reader args live on the dataset task
+    assert {"loader_kwargs", "splitter_kwargs", "parser_kwargs"} <= set(inputs)
+    outputs = list(task.python_interface.outputs)
+    assert outputs == ["model_object", "hyperparameters", "metrics"]
+
+
+def test_train_task_direct_invocation(model):
+    task = model.train_task()
+    raw = model.dataset._reader(sample_frac=1.0, random_state=5)
+    hp_type = model.hyperparameter_type
+    model_obj, hyperparameters, metrics = task(
+        hyperparameters=hp_type(C=0.5, max_iter=200),
+        data=raw,
+        loader_kwargs={},
+        splitter_kwargs={},
+        parser_kwargs={},
+    )
+    assert isinstance(model_obj, LogisticRegression)
+    assert set(metrics) == {"train", "test"}
+    assert all(isinstance(v, float) for v in metrics.values())
+
+
+def test_train_local(model):
+    model_obj, metrics = model.train(hyperparameters={"C": 1.0, "max_iter": 500})
+    assert isinstance(model_obj, LogisticRegression)
+    assert model.artifact is not None
+    assert model.artifact.model_object is model_obj
+    assert set(metrics) == {"train", "test"}
+
+
+def test_train_kwargs_overrides(model):
+    _, metrics = model.train(
+        hyperparameters={"C": 1.0, "max_iter": 500},
+        splitter_kwargs={"test_size": 0.4, "shuffle": False},
+        sample_frac=0.8,
+        random_state=7,
+    )
+    assert set(metrics) == {"train", "test"}
+
+
+def test_predict_paths_agree(trained_model):
+    features = trained_model.dataset._reader(sample_frac=1.0, random_state=5).drop(columns=["y"])
+    from_features = trained_model.predict(features=features.to_dict(orient="records"))
+    task = trained_model.predict_from_features_task()
+    direct = task(
+        model_object=trained_model.artifact.model_object,
+        features=trained_model.dataset.get_features(features.to_dict(orient="records")),
+    )
+    assert from_features == direct
+    assert all(isinstance(x, float) for x in from_features)
+
+
+def test_predict_from_reader_kwargs(trained_model):
+    predictions = trained_model.predict(sample_frac=0.5, random_state=3)
+    assert len(predictions) == 50
+
+
+def test_predict_requires_artifact(model):
+    with pytest.raises(RuntimeError, match="ModelArtifact not found"):
+        model.predict(sample_frac=1.0)
+
+
+def test_predict_requires_features_or_kwargs(trained_model):
+    with pytest.raises(ValueError, match="At least one of features"):
+        trained_model.predict()
+
+
+def test_saver_loader_path_and_fileobj(trained_model, tmp_path):
+    path = tmp_path / "model.joblib"
+    trained_model.save(path)
+    reloaded = make_sklearn_model()
+    obj = reloaded.load(path)
+    assert isinstance(obj, LogisticRegression)
+    np.testing.assert_array_equal(obj.coef_, trained_model.artifact.model_object.coef_)
+
+    buf = io.BytesIO()
+    trained_model.save(buf)
+    buf.seek(0)
+    reloaded2 = make_sklearn_model()
+    obj2 = reloaded2.load(buf)
+    assert isinstance(obj2, LogisticRegression)
+
+
+def test_load_from_env(trained_model, tmp_path, monkeypatch):
+    path = tmp_path / "model.joblib"
+    trained_model.save(path)
+    monkeypatch.setenv("UNIONML_MODEL_PATH", str(path))
+    fresh = make_sklearn_model()
+    obj = fresh.load_from_env()
+    assert isinstance(obj, LogisticRegression)
+
+
+def test_stage_in_plain_workflow(trained_model):
+    """Embed unionml stages in an ordinary workflow (ref ``test_model.py:150-201``)."""
+    predict_task = trained_model.predict_from_features_task()
+    wf = Workflow("wrapper")
+    wf.add_workflow_input("model_object", LogisticRegression)
+    wf.add_workflow_input("features", pd.DataFrame)
+    node = wf.add_entity(
+        predict_task, model_object=wf.inputs["model_object"], features=wf.inputs["features"]
+    )
+    wf.add_workflow_output("preds", node.outputs["o0"])
+    features = trained_model.dataset._reader(sample_frac=0.1, random_state=0).drop(columns=["y"])
+    preds = wf(model_object=trained_model.artifact.model_object, features=features)
+    assert len(preds) == 10
+
+
+def test_schedule_registration(model):
+    model.schedule_training("nightly", expression="0 0 * * *", hyperparameters={"C": 1.0, "max_iter": 100})
+    assert model.training_schedule_names == ["nightly"]
+    with pytest.raises(ValueError, match="unique name"):
+        model.schedule_training("nightly", expression="0 1 * * *")
+
+    model.train(hyperparameters={"C": 1.0, "max_iter": 100})
+    model.schedule_prediction("hourly-preds", expression="@hourly")
+    assert model.prediction_schedule_names == ["hourly-preds"]
+
+
+def test_schedule_decorators(model):
+    from datetime import timedelta
+
+    model.schedule_training("rate", fixed_rate=timedelta(hours=6))
+    assert model.training_schedules[0].fixed_rate == timedelta(hours=6)
+
+
+def test_resolve_model_artifact_precedence(trained_model, tmp_path):
+    obj = LogisticRegression()
+    artifact = trained_model.resolve_model_artifact(model_object=obj)
+    assert artifact.model_object is obj
+
+    path = tmp_path / "m.joblib"
+    trained_model.save(path)
+    artifact = trained_model.resolve_model_artifact(model_file=path)
+    assert isinstance(artifact.model_object, LogisticRegression)
+
+    assert trained_model.resolve_model_artifact() is not None
+
+    with pytest.raises(ValueError, match="only one of"):
+        trained_model.resolve_model_artifact(model_object=obj, model_file=path)
+
+
+def test_resolve_model_artifact_missing():
+    model = make_sklearn_model()
+    with pytest.raises(ModelArtifactNotFound):
+        model.resolve_model_artifact()
+
+
+def test_hyperparameter_type_strategies():
+    dataset = make_dataset()
+
+    # explicit config
+    m1 = Model(name="m1", init=LogisticRegression, dataset=dataset, hyperparameter_config={"C": float})
+    hp = m1.hyperparameter_type(C=2.0)
+    assert hp.C == 2.0 and hp.to_dict() == {"C": 2.0}
+
+    # single dict-annotated init arg
+    def init_dict(hp: dict) -> LogisticRegression:
+        return LogisticRegression(**hp)
+
+    m2 = Model(name="m2", init=init_dict, dataset=make_dataset())
+    assert m2.hyperparameter_type is dict
+
+    # annotated signature
+    def init_annotated(C: float = 1.0, max_iter: int = 100) -> LogisticRegression:
+        return LogisticRegression(C=C, max_iter=max_iter)
+
+    m3 = Model(name="m3", init=init_annotated, dataset=make_dataset())
+    hp3 = m3.hyperparameter_type(C=0.1)
+    assert hp3.C == 0.1 and hp3.max_iter == 100
+
+
+def test_prediction_callbacks():
+    calls = []
+
+    dataset = make_dataset()
+    model = Model(name="cb_model", init=LogisticRegression, dataset=dataset)
+
+    def record(model_obj: LogisticRegression, features: pd.DataFrame, predictions: List[float]):
+        calls.append(len(predictions))
+
+    def broken(model_obj: LogisticRegression, features: pd.DataFrame, predictions: List[float]):
+        raise RuntimeError("boom")
+
+    @model.trainer
+    def trainer(model_obj: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+        return model_obj.fit(features, target.squeeze())
+
+    @model.predictor(callbacks=[record, broken])
+    def predictor(model_obj: LogisticRegression, features: pd.DataFrame) -> List[float]:
+        return [float(x) for x in model_obj.predict(features)]
+
+    @model.evaluator
+    def evaluator(model_obj: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        return float(model_obj.score(features, target.squeeze()))
+
+    model.train(hyperparameters={"max_iter": 100})
+    features = dataset._reader(sample_frac=0.1, random_state=0).drop(columns=["y"])
+    # callbacks fire and the broken one is swallowed (ref model.py:608-612)
+    preds = model.predict(features=features.to_dict(orient="records"))
+    assert calls == [10]
+    assert len(preds) == 10
+
+    with pytest.raises(ValueError, match="only be set once"):
+        model.predict_callbacks = (record,)

@@ -1,0 +1,119 @@
+"""Regression tests for review findings (dict datasets, hybrid mesh, offsets, workers)."""
+
+from datetime import datetime, timedelta
+from typing import Dict
+
+import jax
+import numpy as np
+import pytest
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.parallel.mesh import make_hybrid_mesh
+from unionml_tpu.schedule import Schedule, next_fire_time, parse_iso_duration
+
+
+def test_dict_dataset_trains_end_to_end():
+    """Default parser yields (features, targets) for dict datasets; trainer must get both."""
+    dataset = Dataset(name="dict_ds", targets=["y"])
+
+    @dataset.reader
+    def reader(n: int = 40) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=n).astype(np.float32)
+        return {"x": x, "y": (x > 0).astype(np.float32)}
+
+    def init(threshold: float = 0.0) -> dict:
+        return {"threshold": threshold}
+
+    model = Model(name="dict_model", init=init, dataset=dataset)
+
+    @model.trainer
+    def trainer(m: dict, features: Dict[str, np.ndarray], targets: Dict[str, np.ndarray]) -> dict:
+        return {"threshold": float(np.median(features["x"]))}
+
+    @model.predictor
+    def predictor(m: dict, features: Dict[str, np.ndarray]) -> np.ndarray:
+        return (features["x"] > m["threshold"]).astype(np.float32)
+
+    @model.evaluator
+    def evaluator(m: dict, features: Dict[str, np.ndarray], targets: Dict[str, np.ndarray]) -> float:
+        return float(np.mean(predictor(m, features) == targets["y"]))
+
+    obj, metrics = model.train()
+    assert set(metrics) == {"train", "test"}
+    assert 0.0 <= metrics["test"] <= 1.0
+
+
+def test_make_hybrid_mesh_cpu():
+    """Hybrid mesh: per-axis ICI x DCN extents over the union of axis names."""
+    mesh = make_hybrid_mesh(ici_axes={"data": 4}, dcn_axes={"replica": 2})
+    assert mesh.axis_names == ("replica", "data")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"replica": 2, "data": 4}
+
+
+def test_parse_iso_duration():
+    assert parse_iso_duration("P1D") == timedelta(days=1)
+    assert parse_iso_duration("PT30M") == timedelta(minutes=30)
+    assert parse_iso_duration("P1DT2H") == timedelta(days=1, hours=2)
+    with pytest.raises(Exception):
+        parse_iso_duration("P1Y")
+
+
+def test_next_fire_time_applies_offset():
+    schedule = Schedule(type="trainer", name="s", expression="0 0 * * *", offset="PT2H")
+    fire = next_fire_time(schedule, datetime(2026, 7, 1, 10, 0))
+    assert fire == datetime(2026, 7, 2, 2, 0)
+
+
+def test_dead_worker_is_reaped(tmp_path):
+    """A worker that dies without writing a status must surface as FAILED, not hang."""
+    from unionml_tpu.backend import Execution, LocalBackend
+    from unionml_tpu.exceptions import BackendError
+
+    backend = LocalBackend(root=tmp_path)
+    exec_dir = tmp_path / "deadexec"
+    exec_dir.mkdir(parents=True)
+    (exec_dir / "status").write_text("RUNNING")
+    (exec_dir / "pid").write_text("999999999")  # certainly not a live pid
+    execution = Execution("deadexec", exec_dir, backend)
+    with pytest.raises(BackendError, match="failed"):
+        backend.wait(execution, timeout=5)
+    assert execution.status == "FAILED"
+
+
+def test_resident_predictor_pytree_output():
+    """Padding slice must recurse into dict predictor outputs."""
+    from unionml_tpu.serving.resident import ResidentPredictor
+
+    dataset = Dataset(name="rp_ds", features=["a", "b"], targets=["y"], device_format="jax")
+
+    import pandas as pd
+
+    @dataset.reader
+    def reader() -> pd.DataFrame:
+        return pd.DataFrame({"a": [0.0, 1.0], "b": [1.0, 0.0], "y": [0, 1]})
+
+    params = {"w": jax.numpy.ones((2,))}
+    model = Model(name="rp_model", init=lambda: params, dataset=dataset)
+
+    @model.trainer
+    def trainer(p: dict, X: jax.Array, y: jax.Array) -> dict:
+        return p
+
+    @model.predictor
+    def predictor(p: dict, X: jax.Array) -> Dict[str, jax.Array]:
+        return {"logits": X @ p["w"], "index": jax.numpy.arange(X.shape[0])}
+
+    @model.evaluator
+    def evaluator(p: dict, X: jax.Array, y: jax.Array) -> float:
+        return 1.0
+
+    model.train()
+    resident = ResidentPredictor(model, buckets=(4, 8), warmup=False)
+    resident.setup()
+    out = resident.predict(
+        features=[{"a": 1.0, "b": 2.0}, {"a": 3.0, "b": 4.0}, {"a": 5.0, "b": 6.0}]
+    )
+    assert set(out) == {"logits", "index"}
+    assert out["logits"].shape == (3,)  # padded to 4, sliced back to 3
+    assert out["index"].shape == (3,)

@@ -1,0 +1,57 @@
+"""Schedule spec + cron engine tests (ref ``tests/unit/test_schedule.py:34-103``)."""
+
+from datetime import datetime, timedelta
+
+import pytest
+
+from unionml_tpu.exceptions import ScheduleError
+from unionml_tpu.schedule import Schedule, ScheduleType, create_scheduled_job, next_fire_time, parse_cron
+
+
+def test_schedule_type_coercion():
+    schedule = Schedule(type="trainer", name="s", expression="0 0 * * *")
+    assert schedule.type is ScheduleType.trainer
+
+
+def test_exactly_one_of_expression_or_fixed_rate():
+    with pytest.raises(ScheduleError, match="not both"):
+        Schedule(type="trainer", name="s", expression="0 0 * * *", fixed_rate=timedelta(hours=1)).validate()
+    with pytest.raises(ScheduleError, match="exactly one"):
+        Schedule(type="trainer", name="s").validate()
+
+
+def test_create_scheduled_job():
+    job = create_scheduled_job("m.train", "nightly", expression="@daily", inputs={"a": 1})
+    assert job.type is ScheduleType.trainer
+    assert job.inputs == {"a": 1}
+
+    job2 = create_scheduled_job("m.predict", "preds", fixed_rate=timedelta(minutes=30), fixed_inputs={"b": 2})
+    assert job2.type is ScheduleType.predictor
+    assert job2.inputs == {"b": 2}
+
+
+def test_parse_cron_rejects_garbage():
+    for bad in ("* * *", "61 * * * *", "* 25 * * *", "a b c d e"):
+        with pytest.raises(ScheduleError):
+            parse_cron(bad)
+
+
+@pytest.mark.parametrize(
+    "expression,after,expected",
+    [
+        ("0 0 * * *", datetime(2026, 7, 1, 10, 30), datetime(2026, 7, 2, 0, 0)),
+        ("@hourly", datetime(2026, 7, 1, 10, 30), datetime(2026, 7, 1, 11, 0)),
+        ("*/15 * * * *", datetime(2026, 7, 1, 10, 7), datetime(2026, 7, 1, 10, 15)),
+        ("0 9 * * mon", datetime(2026, 7, 1, 10, 0), datetime(2026, 7, 6, 9, 0)),
+        ("30 6 1 * *", datetime(2026, 7, 2, 0, 0), datetime(2026, 8, 1, 6, 30)),
+    ],
+)
+def test_next_fire_time_cron(expression, after, expected):
+    schedule = Schedule(type="trainer", name="s", expression=expression)
+    assert next_fire_time(schedule, after) == expected
+
+
+def test_next_fire_time_fixed_rate():
+    schedule = Schedule(type="predictor", name="s", fixed_rate=timedelta(minutes=10))
+    after = datetime(2026, 7, 1, 10, 0)
+    assert next_fire_time(schedule, after) == datetime(2026, 7, 1, 10, 10)

@@ -1,0 +1,131 @@
+"""Stage runtime tests: jit policies, eager fallback, caching, interfaces."""
+
+import inspect
+from collections import OrderedDict
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.exceptions import StageError
+from unionml_tpu.stage import Stage, TracedFunction, is_jax_compatible, stage
+
+
+class Owner:
+    name = "owner"
+
+
+def test_is_jax_compatible():
+    assert is_jax_compatible((jnp.ones(3), np.ones(3), 1.0, 2))
+    assert is_jax_compatible({"a": jnp.ones(3)})
+    assert not is_jax_compatible(("str-leaf",))
+
+    class Opaque:
+        ...
+
+    assert not is_jax_compatible((Opaque(),))
+
+
+def test_traced_function_compiles_jax_inputs():
+    calls = []
+
+    def fn(x, y):
+        calls.append(1)  # traced once per shape, not per call
+        return x @ y
+
+    traced = TracedFunction(fn, jit="auto")
+    a, b = jnp.ones((4, 8)), jnp.ones((8, 2))
+    out1 = traced(a, b)
+    out2 = traced(a, b)
+    assert out1.shape == (4, 2)
+    assert len(calls) == 1, "second call must hit the compiled executable"
+    assert traced.uses_jit
+
+
+def test_traced_function_eager_for_opaque_inputs():
+    class Opaque:
+        def fit(self):
+            return self
+
+    def fn(model, x):
+        return model.fit()
+
+    traced = TracedFunction(fn, jit="auto")
+    model = Opaque()
+    assert traced(model, jnp.ones(3)) is model
+    assert not traced.uses_jit  # permanently eager after first opaque call
+
+
+def test_traced_function_jit_true_raises_on_untraceable():
+    def fn(x):
+        if x[0] > 0:  # data-dependent python control flow
+            return x
+        return -x
+
+    traced = TracedFunction(fn, jit=True)
+    with pytest.raises(StageError):
+        traced(jnp.ones(3))
+
+
+def test_traced_function_auto_falls_back_on_trace_error():
+    def fn(x):
+        if float(x[0]) > 0:
+            return x
+        return -x
+
+    traced = TracedFunction(fn, jit="auto")
+    out = traced(jnp.ones(3))
+    np.testing.assert_array_equal(np.asarray(out), np.ones(3))
+
+
+def test_traced_function_static_string_kwarg():
+    def fn(x, *, mode: str = "double"):
+        return x * 2 if mode == "double" else x
+
+    traced = TracedFunction(fn, jit="auto")
+    out = traced(jnp.ones(3), mode="double")
+    np.testing.assert_array_equal(np.asarray(out), 2 * np.ones(3))
+
+
+def test_stage_factory_interface():
+    owner = Owner()
+
+    @stage(unionml_obj=owner)
+    def my_stage(a: int, b: int = 2) -> int:
+        return a + b
+
+    assert my_stage.name == "owner.my_stage"
+    assert list(my_stage.python_interface.inputs) == ["a", "b"]
+    assert my_stage(a=1) == 3
+    with pytest.raises(StageError, match="unknown arguments"):
+        my_stage(a=1, c=5)
+
+
+def test_stage_namedtuple_outputs():
+    owner = Owner()
+    Out = NamedTuple("Out", x=int, y=int)
+
+    @stage(unionml_obj=owner, return_annotation=Out)
+    def pair(a: int) -> Out:
+        return Out(a, a + 1)
+
+    assert list(pair.python_interface.outputs) == ["x", "y"]
+
+
+def test_stage_result_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path))
+    owner = Owner()
+    counter = {"n": 0}
+
+    @stage(unionml_obj=owner, cache=True, cache_version="v1")
+    def costly(a: int) -> int:
+        counter["n"] += 1
+        return a * 10
+
+    assert costly(a=3) == 30
+    assert costly(a=3) == 30
+    assert counter["n"] == 1, "second call must be served from the content-hash cache"
+    assert costly(a=4) == 40
+    assert counter["n"] == 2

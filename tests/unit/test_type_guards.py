@@ -1,0 +1,261 @@
+"""Table-driven signature-guard matrices (ref ``tests/unit/test_type_guards.py:13-459``)."""
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from unionml_tpu import type_guards
+
+
+class FakeModel:
+    ...
+
+
+# ---------------------------------------------------------------- reader
+
+def test_guard_reader():
+    def good() -> pd.DataFrame:
+        ...
+
+    def bad():
+        ...
+
+    type_guards.guard_reader(good)
+    with pytest.raises(TypeError):
+        type_guards.guard_reader(bad)
+
+
+# ---------------------------------------------------------------- loader
+
+@pytest.mark.parametrize(
+    "annotation,ok",
+    [
+        (pd.DataFrame, True),
+        (Any, True),
+        (Union[pd.DataFrame, str], True),
+        (int, False),
+    ],
+)
+def test_guard_loader(annotation, ok):
+    def loader(data: annotation) -> pd.DataFrame:  # type: ignore[valid-type]
+        ...
+
+    loader.__annotations__["data"] = annotation
+    if ok:
+        type_guards.guard_loader(loader, pd.DataFrame)
+    else:
+        with pytest.raises(TypeError):
+            type_guards.guard_loader(loader, pd.DataFrame)
+
+
+# ---------------------------------------------------------------- splitter
+
+def test_guard_splitter_valid():
+    def splitter(
+        data: pd.DataFrame, test_size: float, shuffle: bool, random_state: int
+    ) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+def test_guard_splitter_bad_output():
+    def splitter(data: pd.DataFrame, test_size: float, shuffle: bool, random_state: int) -> pd.DataFrame:
+        ...
+
+    with pytest.raises(TypeError, match="List, Tuple, or NamedTuple"):
+        type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+def test_guard_splitter_mismatched_elements():
+    def splitter(data: pd.DataFrame, test_size: float, shuffle: bool, random_state: int) -> Tuple[str, str]:
+        ...
+
+    with pytest.raises(TypeError, match="must match"):
+        type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+def test_guard_splitter_missing_kwarg():
+    def splitter(data: pd.DataFrame, test_size: float, shuffle: bool) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    with pytest.raises(TypeError, match="random_state"):
+        type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+def test_guard_splitter_wrong_kwarg_type():
+    def splitter(
+        data: pd.DataFrame, test_size: int, shuffle: bool, random_state: int
+    ) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    with pytest.raises(TypeError, match="test_size"):
+        type_guards.guard_splitter(splitter, pd.DataFrame, "reader")
+
+
+# ---------------------------------------------------------------- parser
+
+def test_guard_parser_valid():
+    def parser(
+        data: pd.DataFrame, features: Optional[List[str]], targets: List[str]
+    ) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    type_guards.guard_parser(parser, pd.DataFrame, "reader")
+
+
+def test_guard_parser_invalid_kwargs():
+    def parser(data: pd.DataFrame, features: List[str], targets: List[str]) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        ...
+
+    with pytest.raises(TypeError, match="features"):
+        type_guards.guard_parser(parser, pd.DataFrame, "reader")
+
+
+# ---------------------------------------------------------------- trainer
+
+def test_guard_trainer_valid():
+    def trainer(model: FakeModel, features: pd.DataFrame, target: pd.DataFrame) -> FakeModel:
+        ...
+
+    type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_trainer_wrong_model_type():
+    def trainer(model: int, features: pd.DataFrame, target: pd.DataFrame) -> int:
+        ...
+
+    with pytest.raises(TypeError):
+        type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_trainer_wrong_arity():
+    def trainer(model: FakeModel, features: pd.DataFrame) -> FakeModel:
+        ...
+
+    with pytest.raises(TypeError, match="positional data arguments"):
+        type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_trainer_keyword_only_args_allowed():
+    def trainer(model: FakeModel, features: pd.DataFrame, target: pd.DataFrame, *, epochs: int = 5) -> FakeModel:
+        ...
+
+    type_guards.guard_trainer(trainer, FakeModel, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_trainer_array_family_compatible():
+    """TPU-native: np.ndarray annotations satisfy jax.Array expectations and vice versa."""
+
+    def trainer(model: FakeModel, features: jax.Array, target: jax.Array) -> FakeModel:
+        ...
+
+    type_guards.guard_trainer(trainer, FakeModel, (np.ndarray, np.ndarray))
+
+
+# ---------------------------------------------------------------- evaluator / predictor
+
+def test_guard_evaluator_valid():
+    def evaluator(model: FakeModel, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        ...
+
+    type_guards.guard_evaluator(evaluator, FakeModel, (pd.DataFrame, pd.DataFrame))
+
+
+def test_guard_predictor_valid():
+    def predictor(model: FakeModel, features: pd.DataFrame) -> List[float]:
+        ...
+
+    type_guards.guard_predictor(predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_predictor_union_features():
+    def predictor(model: FakeModel, features: Union[pd.DataFrame, np.ndarray]) -> List[float]:
+        ...
+
+    type_guards.guard_predictor(predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_predictor_needs_single_features_arg():
+    def predictor(model: FakeModel, a: pd.DataFrame, b: pd.DataFrame) -> List[float]:
+        ...
+
+    with pytest.raises(TypeError, match="single 'features'"):
+        type_guards.guard_predictor(predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_predictor_needs_return_annotation():
+    def predictor(model: FakeModel, features: pd.DataFrame):
+        ...
+
+    with pytest.raises(TypeError, match="return type"):
+        type_guards.guard_predictor(predictor, FakeModel, pd.DataFrame)
+
+
+# ---------------------------------------------------------------- callbacks
+
+def _predictor(model: FakeModel, features: pd.DataFrame) -> List[float]:
+    ...
+
+
+def test_guard_callback_valid():
+    def callback(model: FakeModel, features: pd.DataFrame, predictions: List[float]):
+        ...
+
+    type_guards.guard_prediction_callback(callback, _predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_callback_must_return_none():
+    def callback(model: FakeModel, features: pd.DataFrame, predictions: List[float]) -> int:
+        ...
+
+    with pytest.raises(TypeError, match="None"):
+        type_guards.guard_prediction_callback(callback, _predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_callback_wrong_arity():
+    def callback(model: FakeModel, features: pd.DataFrame):
+        ...
+
+    with pytest.raises(TypeError, match="'features' and 'prediction'"):
+        type_guards.guard_prediction_callback(callback, _predictor, FakeModel, pd.DataFrame)
+
+
+def test_guard_callback_wrong_prediction_type():
+    def callback(model: FakeModel, features: pd.DataFrame, predictions: int):
+        ...
+
+    with pytest.raises(TypeError, match="third argument"):
+        type_guards.guard_prediction_callback(callback, _predictor, FakeModel, pd.DataFrame)
+
+
+# ---------------------------------------------------------------- feature loader / transformer
+
+def test_guard_feature_loader():
+    def loader(raw: Any) -> pd.DataFrame:
+        ...
+
+    type_guards.guard_feature_loader(loader, Any)
+
+    def bad(a: Any, b: Any) -> pd.DataFrame:
+        ...
+
+    with pytest.raises(TypeError, match="single argument"):
+        type_guards.guard_feature_loader(bad, Any)
+
+
+def test_guard_feature_transformer():
+    def transformer(features: pd.DataFrame) -> pd.DataFrame:
+        ...
+
+    type_guards.guard_feature_transformer(transformer, pd.DataFrame)
+
+    def bad(features: int) -> int:
+        ...
+
+    with pytest.raises(TypeError):
+        type_guards.guard_feature_transformer(bad, pd.DataFrame)

@@ -1,0 +1,122 @@
+"""Workflow DAG executor + instance tracker tests."""
+
+import subprocess
+import sys
+import textwrap
+from collections import OrderedDict
+from pathlib import Path
+
+import pytest
+
+from unionml_tpu.exceptions import WorkflowError
+from unionml_tpu.stage import stage
+from unionml_tpu.tracker import TrackedInstance, load_tracked_instance
+from unionml_tpu.workflow import Workflow
+
+
+class Owner:
+    name = "o"
+
+
+def _make_stage(fn):
+    return stage(fn, unionml_obj=Owner())
+
+
+def test_workflow_topological_execution():
+    @_make_stage
+    def double(x: int) -> int:
+        return x * 2
+
+    @_make_stage
+    def add(a: int, b: int) -> int:
+        return a + b
+
+    wf = Workflow("wf")
+    wf.add_workflow_input("x", int)
+    n1 = wf.add_entity(double, x=wf.inputs["x"])
+    n2 = wf.add_entity(add, a=n1.outputs["o0"], b=wf.inputs["x"])
+    wf.add_workflow_output("result", n2.outputs["o0"])
+    assert wf(x=3) == 9
+
+
+def test_workflow_literal_bindings_and_defaults():
+    @_make_stage
+    def add(a: int, b: int) -> int:
+        return a + b
+
+    wf = Workflow("wf")
+    wf.add_workflow_input("a", int, default=10)
+    node = wf.add_entity(add, a=wf.inputs["a"], b=5)
+    wf.add_workflow_output("out", node.outputs["o0"])
+    assert wf() == 15
+    assert wf(a=1) == 6
+
+
+def test_workflow_errors():
+    @_make_stage
+    def identity(x: int) -> int:
+        return x
+
+    wf = Workflow("wf")
+    wf.add_workflow_input("x", int)
+    with pytest.raises(WorkflowError, match="no inputs named"):
+        wf.add_entity(identity, nope=1)
+    node = wf.add_entity(identity, x=wf.inputs["x"])
+    wf.add_workflow_output("out", node.outputs["o0"])
+    with pytest.raises(WorkflowError, match="missing required input"):
+        wf()
+    with pytest.raises(WorkflowError, match="unknown inputs"):
+        wf(x=1, y=2)
+    with pytest.raises(WorkflowError, match="already has an input"):
+        wf.add_workflow_input("x", int)
+
+
+class Tracked(TrackedInstance):
+    def __init__(self, name: str):
+        super().__init__()
+        self.name = name
+
+
+MODULE_LEVEL_INSTANCE = Tracked("module-level")
+
+
+def test_tracker_records_module():
+    assert MODULE_LEVEL_INSTANCE.instantiated_in == __name__
+
+
+def test_find_lhs():
+    assert MODULE_LEVEL_INSTANCE.find_lhs() == "MODULE_LEVEL_INSTANCE"
+
+
+def test_load_tracked_instance():
+    obj = load_tracked_instance(__name__, "MODULE_LEVEL_INSTANCE")
+    assert obj is MODULE_LEVEL_INSTANCE
+
+
+def test_tracker_main_module_rehydration(tmp_path):
+    """A script run as __main__ must still be resolvable by module path (ref tracker.py:23-34)."""
+    app = tmp_path / "tracked_app.py"
+    app.write_text(
+        textwrap.dedent(
+            """
+            import sys
+            sys.path.insert(0, {repo!r})
+            from unionml_tpu.tracker import TrackedInstance
+
+            class T(TrackedInstance):
+                def __init__(self, name):
+                    super().__init__()
+                    self.name = name
+
+            instance = T("from-main")
+            print(instance.instantiated_in, instance.find_lhs())
+            """.format(repo=str(Path(__file__).resolve().parents[2]))
+        )
+    )
+    result = subprocess.run(
+        [sys.executable, str(app)], capture_output=True, text=True, cwd=tmp_path,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stderr
+    # the fallback re-executes the module once, so the line may print twice
+    assert result.stdout.split()[-2:] == ["tracked_app", "instance"]

@@ -1,0 +1,16 @@
+"""unionml-tpu: a TPU-native ML microservice framework.
+
+The two core exports mirror the reference's public surface
+(``unionml/__init__.py:4-5``): :class:`~unionml_tpu.dataset.Dataset` and
+:class:`~unionml_tpu.model.Model`. Everything the user registers through their
+decorators becomes jit/pjit-compiled stages executed locally, behind an HTTP endpoint
+with a resident XLA predictor, or on the execution backend with versioned artifacts and
+schedules.
+"""
+
+from unionml_tpu.dataset import Dataset
+from unionml_tpu.model import BaseHyperparameters, Model, ModelArtifact
+
+__version__ = "0.1.0"
+
+__all__ = ["Dataset", "Model", "ModelArtifact", "BaseHyperparameters", "__version__"]

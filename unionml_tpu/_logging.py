@@ -1,0 +1,29 @@
+"""Structured logging for unionml_tpu.
+
+Reference parity: ``unionml/_logging.py:1-7`` (a single stream logger). This version adds
+per-stage timing support used by the stage runtime (SURVEY.md §5 "metrics/logging").
+"""
+
+import contextlib
+import logging
+import time
+from typing import Iterator
+
+logger = logging.getLogger("unionml_tpu")
+
+if not logger.handlers:
+    _handler = logging.StreamHandler()
+    _handler.setFormatter(logging.Formatter("[%(name)s] %(asctime)s %(levelname)s: %(message)s"))
+    logger.addHandler(_handler)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+
+
+@contextlib.contextmanager
+def log_duration(event: str, level: int = logging.DEBUG) -> Iterator[None]:
+    """Log wall-clock duration of a block, used for per-stage timing."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.log(level, "%s took %.4fs", event, time.perf_counter() - start)

@@ -1,0 +1,100 @@
+"""Worker entrypoint: rehydrate the app module and execute a workflow.
+
+This is the process/machine boundary of the backend — the analogue of the reference's
+task resolver running inside a remote container (``unionml/task_resolver.py:16-31``):
+the worker receives an execution directory containing ``meta.json`` with the app's
+``(module, variable)`` address, re-imports the module (which re-runs the ``Dataset``/
+``Model`` decorators), rebuilds the named workflow, and executes it.
+
+On a multi-host TPU slice every host runs this same entrypoint; host 0 writes outputs.
+``jax.distributed`` initialization happens here (before any jax computation) when the
+job's resource spec declares ``host_count > 1``.
+"""
+
+import json
+import pickle
+import sys
+from pathlib import Path
+from typing import Any, Dict
+
+
+def _resolve_workflow(model: Any, workflow_name: str):
+    """Map a workflow name back to its factory on the rehydrated model object."""
+    factories = {
+        model.train_workflow_name: model.train_workflow,
+        model.predict_workflow_name: model.predict_workflow,
+        model.predict_from_features_workflow_name: model.predict_from_features_workflow,
+    }
+    try:
+        return factories[workflow_name]()
+    except KeyError:
+        raise ValueError(
+            f"Workflow {workflow_name!r} is not one of {sorted(factories)} for model {model.name!r}"
+        ) from None
+
+
+def _coerce_inputs(workflow, inputs: Dict[str, Any]) -> Dict[str, Any]:
+    """Rebuild typed kwargs dataclasses from the plain-dict wire format."""
+    coerced = {}
+    for name, annotation in workflow.input_types.items():
+        value = inputs.get(name)
+        if (
+            isinstance(value, dict)
+            and isinstance(annotation, type)
+            and hasattr(annotation, "from_dict")
+        ):
+            coerced[name] = annotation.from_dict(value)
+        elif name in inputs:
+            coerced[name] = value
+    return coerced
+
+
+def run_workflow_for_model(model: Any, workflow_name: str, inputs: Dict[str, Any]) -> Dict[str, Any]:
+    """Execute a named workflow and map positional results to named outputs."""
+    workflow = _resolve_workflow(model, workflow_name)
+    result = workflow(**_coerce_inputs(workflow, inputs))
+    names = workflow.output_names
+    if len(names) == 1:
+        return {names[0]: result}
+    return dict(zip(names, result))
+
+
+def run_execution(execution_dir: Path) -> int:
+    from unionml_tpu._logging import logger
+    from unionml_tpu.tracker import load_tracked_instance
+
+    with (execution_dir / "meta.json").open() as f:
+        meta = json.load(f)
+    (execution_dir / "status").write_text("RUNNING")
+
+    try:
+        resources = meta.get("resources") or {}
+        if resources.get("host_count", 1) > 1:
+            from unionml_tpu.parallel.distributed import initialize_distributed
+
+            initialize_distributed()
+
+        model = load_tracked_instance(meta["app_module"], meta["app_variable"], meta.get("module_file"))
+        with (execution_dir / "inputs.pkl").open("rb") as f:
+            inputs = pickle.load(f)
+        outputs = run_workflow_for_model(model, meta["workflow_name"], inputs)
+        with (execution_dir / "outputs.pkl").open("wb") as f:
+            pickle.dump(outputs, f)
+        (execution_dir / "status").write_text("SUCCEEDED")
+        return 0
+    except Exception as exc:  # record failure for the waiting client
+        logger.exception("Worker failed for execution %s", meta.get("execution_id"))
+        (execution_dir / "error.txt").write_text(repr(exc))
+        (execution_dir / "status").write_text("FAILED")
+        return 1
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: python -m unionml_tpu.backend.worker <execution_dir>", file=sys.stderr)
+        raise SystemExit(2)
+    raise SystemExit(run_execution(Path(sys.argv[1])))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Parallelism engine: meshes, data parallelism, sequence parallelism, multi-host.
+
+See :mod:`unionml_tpu.parallel.mesh` for the axis conventions and the design stance:
+communication is sharding annotations over a Mesh, lowered by XLA to ICI/DCN
+collectives — the TPU-native replacement for an NCCL/MPI backend (SURVEY.md §2).
+"""
+
+from unionml_tpu.parallel.dp import batches, data_parallel_eval, data_parallel_step, pad_to_multiple
+from unionml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    SEQUENCE_AXIS,
+    TENSOR_AXIS,
+    MeshSpec,
+    batch_sharding,
+    logical_to_sharding,
+    make_hybrid_mesh,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "SEQUENCE_AXIS",
+    "TENSOR_AXIS",
+    "MeshSpec",
+    "batch_sharding",
+    "batches",
+    "data_parallel_eval",
+    "data_parallel_step",
+    "logical_to_sharding",
+    "make_hybrid_mesh",
+    "make_mesh",
+    "pad_to_multiple",
+    "replicated",
+    "shard_batch",
+]

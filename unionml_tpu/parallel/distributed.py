@@ -1,0 +1,70 @@
+"""Multi-host initialization: joining N hosts into one jax.distributed mesh.
+
+The reference has no distributed backend at all (SURVEY.md §2: inter-task data moves via
+blob store; intra-task is user code). Here multi-host is first-class: every backend
+worker whose job spec declares ``host_count > 1`` calls
+:func:`initialize_distributed` before any jax computation, after which
+``jax.devices()`` spans the full pod slice and meshes built by
+:mod:`unionml_tpu.parallel.mesh` cover all hosts (ICI within a slice, DCN across).
+"""
+
+import os
+from typing import Optional
+
+import jax
+
+from unionml_tpu._logging import logger
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Idempotently initialize ``jax.distributed``.
+
+    On TPU VMs created as one slice, ``jax.distributed.initialize()`` auto-discovers
+    everything from the TPU metadata server; explicit args (or the standard
+    ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` env vars)
+    cover manual fleets.
+    """
+    global _initialized
+    if _initialized:
+        return
+
+    coordinator_address = coordinator_address or os.getenv("JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes if num_processes is not None else _int_env("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _int_env("JAX_PROCESS_ID")
+
+    try:
+        if coordinator_address:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            jax.distributed.initialize()
+        _initialized = True
+        logger.info(
+            "jax.distributed initialized: process %s/%s, %d local / %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            jax.local_device_count(),
+            jax.device_count(),
+        )
+    except (RuntimeError, ValueError) as exc:
+        # single-process contexts (unit tests, one-host slices) are fine without init
+        logger.info("jax.distributed not initialized (%s); continuing single-process.", exc)
+
+
+def _int_env(name: str) -> Optional[int]:
+    value = os.getenv(name)
+    return int(value) if value is not None else None
+
+
+def is_primary_host() -> bool:
+    """True on the host responsible for writing outputs/checkpoints."""
+    return jax.process_index() == 0

@@ -1,0 +1,49 @@
+"""Serving: resident-XLA prediction apps (native aiohttp; optional FastAPI adapter)."""
+
+from typing import Any, Optional
+
+from unionml_tpu.serving.app import build_aiohttp_app, jsonable, load_model_artifact, run_app
+from unionml_tpu.serving.resident import ResidentPredictor
+
+
+def serving_app(
+    model: Any,
+    app: Any = None,
+    remote: bool = False,
+    app_version: Optional[str] = None,
+    model_version: str = "latest",
+    resident: bool = True,
+):
+    """Build or extend a serving app for a model (``unionml/fastapi.py:15`` analogue).
+
+    - ``app=None``: returns the framework's native aiohttp application.
+    - ``app`` is a FastAPI instance (when fastapi is installed): endpoints are attached
+      in place, reference-compatible.
+    """
+    if app is None:
+        return build_aiohttp_app(
+            model, remote=remote, app_version=app_version, model_version=model_version, resident=resident
+        )
+    try:
+        from fastapi import FastAPI
+    except ImportError:
+        FastAPI = None  # type: ignore[assignment]
+    if FastAPI is not None and isinstance(app, FastAPI):
+        from unionml_tpu.serving.fastapi_adapter import attach_fastapi
+
+        return attach_fastapi(
+            model, app, remote=remote, app_version=app_version, model_version=model_version, resident=resident
+        )
+    raise TypeError(
+        f"Unsupported app type {type(app)!r}: pass None for the native app or a fastapi.FastAPI instance."
+    )
+
+
+__all__ = [
+    "ResidentPredictor",
+    "build_aiohttp_app",
+    "jsonable",
+    "load_model_artifact",
+    "run_app",
+    "serving_app",
+]

@@ -1,0 +1,143 @@
+"""Native HTTP serving app (aiohttp): ``/``, ``/predict``, ``/health``.
+
+Reference parity: ``unionml/fastapi.py:15-70`` — same endpoints, same request contract
+(``inputs`` = reader kwargs, or ``features`` = raw features), same startup model-load
+from ``UNIONML_MODEL_PATH`` or from backend lineage. Built on aiohttp rather than
+FastAPI so the framework serves without optional deps; a FastAPI adapter with the same
+handlers lives in :mod:`unionml_tpu.serving.fastapi_adapter`.
+
+The prediction path goes through :class:`~unionml_tpu.serving.resident.ResidentPredictor`
+— the resident XLA executable, not interpreted re-dispatch.
+"""
+
+import os
+from http import HTTPStatus
+from typing import Any, Optional
+
+import numpy as np
+
+from unionml_tpu._logging import logger
+from unionml_tpu.serving.resident import ResidentPredictor
+
+_INDEX_HTML = """
+<html>
+  <head><title>unionml-tpu</title></head>
+  <body>
+    <h1>unionml-tpu</h1>
+    <p>TPU-native model training and serving</p>
+  </body>
+</html>
+"""
+
+
+def jsonable(value: Any) -> Any:
+    """Convert predictions (device arrays, numpy, pandas) to JSON-serializable values."""
+    import jax
+
+    if isinstance(value, jax.Array):
+        value = np.asarray(jax.device_get(value))
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, (np.generic,)):
+        return value.item()
+    if hasattr(value, "to_dict") and not isinstance(value, dict):
+        try:
+            return value.to_dict(orient="records")
+        except TypeError:
+            return value.to_dict()
+    if isinstance(value, (list, tuple)):
+        return [jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {k: jsonable(v) for k, v in value.items()}
+    return value
+
+
+def load_model_artifact(
+    model: Any,
+    remote: bool = False,
+    app_version: Optional[str] = None,
+    model_version: str = "latest",
+    model_path: Optional[str] = None,
+) -> None:
+    """Startup model resolution (``fastapi.py:22-34`` parity)."""
+    if model.artifact is not None:
+        return
+    model_path = model_path or os.getenv("UNIONML_MODEL_PATH")
+    if not remote:
+        if model_path is None:
+            raise ValueError(
+                "Model artifact path not specified: pass --model-path to `unionml-tpu serve` (local mode)."
+            )
+        model.load(model_path)
+    else:
+        from unionml_tpu.remote import get_model_artifact
+
+        model.artifact = get_model_artifact(model, app_version=app_version, model_version=model_version)
+
+
+def build_aiohttp_app(
+    model: Any,
+    remote: bool = False,
+    app_version: Optional[str] = None,
+    model_version: str = "latest",
+    resident: bool = True,
+):
+    """Create the aiohttp application with a resident predictor."""
+    from aiohttp import web
+
+    app = web.Application()
+    predictor = ResidentPredictor(model) if resident else None
+
+    async def on_startup(app):
+        load_model_artifact(model, remote=remote, app_version=app_version, model_version=model_version)
+        if predictor is not None:
+            predictor.setup()
+        logger.info("Serving app ready (model=%s).", model.name)
+
+    app.on_startup.append(on_startup)
+
+    async def index(request):
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+    async def health(request):
+        if model.artifact is None:
+            return web.json_response({"detail": "Model artifact not found."}, status=500)
+        return web.json_response({"message": HTTPStatus.OK.phrase, "status": HTTPStatus.OK.value})
+
+    async def predict(request):
+        try:
+            payload = await request.json()
+        except Exception:
+            return web.json_response({"detail": "Request body must be JSON."}, status=422)
+        inputs = payload.get("inputs")
+        features = payload.get("features")
+        if inputs is None and features is None:
+            return web.json_response({"detail": "inputs or features must be supplied."}, status=500)
+        try:
+            if inputs:
+                result = (
+                    predictor.predict(**inputs) if predictor is not None else model.predict(**inputs)
+                )
+            else:
+                result = (
+                    predictor.predict(features=features)
+                    if predictor is not None
+                    else model.predict(features=model.dataset.get_features(features))
+                )
+            return web.json_response(jsonable(result))
+        except Exception as exc:
+            logger.exception("Prediction failed")
+            return web.json_response({"detail": f"Prediction failed: {exc}"}, status=500)
+
+    app.router.add_get("/", index)
+    app.router.add_get("/health", health)
+    app.router.add_post("/predict", predict)
+    app["unionml_model"] = model
+    app["resident_predictor"] = predictor
+    return app
+
+
+def run_app(app, host: str = "127.0.0.1", port: int = 8000) -> None:
+    from aiohttp import web
+
+    web.run_app(app, host=host, port=port)

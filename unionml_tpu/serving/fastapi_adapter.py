@@ -1,0 +1,61 @@
+"""FastAPI adapter: attach unionml-tpu serving endpoints to a user's FastAPI app.
+
+Reference parity: ``unionml/fastapi.py:15-70`` — identical endpoint contract. Only
+importable when ``fastapi`` is installed (optional dependency); the native aiohttp app
+(:mod:`unionml_tpu.serving.app`) is the default serving surface.
+"""
+
+from http import HTTPStatus
+from typing import Any, Dict, List, Optional, Union
+
+from fastapi import Body, FastAPI, HTTPException
+from fastapi.responses import HTMLResponse
+
+from unionml_tpu.serving.app import _INDEX_HTML, jsonable, load_model_artifact
+from unionml_tpu.serving.resident import ResidentPredictor
+
+
+def attach_fastapi(
+    model: Any,
+    app: FastAPI,
+    remote: bool = False,
+    app_version: Optional[str] = None,
+    model_version: str = "latest",
+    resident: bool = True,
+) -> FastAPI:
+    predictor = ResidentPredictor(model) if resident else None
+
+    @app.on_event("startup")
+    async def setup_model():
+        load_model_artifact(model, remote=remote, app_version=app_version, model_version=model_version)
+        if predictor is not None:
+            predictor.setup()
+
+    @app.get("/", response_class=HTMLResponse)
+    def root():
+        return _INDEX_HTML
+
+    @app.post("/predict")
+    async def predict(
+        inputs: Optional[Union[Dict[str, Any], None]] = Body(None),
+        features: Optional[List[Any]] = Body(None),
+    ):
+        if inputs is None and features is None:
+            raise HTTPException(status_code=500, detail="inputs or features must be supplied.")
+        if inputs:
+            result = predictor.predict(**inputs) if predictor is not None else model.predict(**inputs)
+        else:
+            result = (
+                predictor.predict(features=features)
+                if predictor is not None
+                else model.predict(features=model.dataset.get_features(features))
+            )
+        return jsonable(result)
+
+    @app.get("/health")
+    async def health():
+        if model.artifact is None:
+            raise HTTPException(status_code=500, detail="Model artifact not found.")
+        return {"message": HTTPStatus.OK.phrase, "status": HTTPStatus.OK.value}
+
+    return app

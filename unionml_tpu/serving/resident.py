@@ -1,0 +1,121 @@
+"""Resident predictor: a pre-compiled XLA executable serving online predictions.
+
+Reference behavior: the FastAPI path routes every request through
+``model.predict(features=...)`` interpreted Python (``unionml/fastapi.py:50-64``). The
+TPU-native rebuild pre-lowers and compiles the predictor at server startup for a ladder
+of padded batch shapes ("bucketing"), so the request path is: host->device transfer,
+run resident executable, device->host — the p50-latency metric in BASELINE.md.
+
+Dynamic request sizes vs XLA static shapes (SURVEY.md §7 "hard parts"): request batches
+pad up to the nearest bucket; predictions slice back down. Opaque model objects
+(sklearn/torch) bypass compilation and run eagerly — same endpoint, same semantics.
+"""
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from unionml_tpu._logging import logger
+from unionml_tpu.stage import is_jax_compatible
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class ResidentPredictor:
+    """Holds a model artifact on-device with a compiled predict executable."""
+
+    def __init__(
+        self,
+        model: Any,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        warmup: bool = True,
+    ):
+        self._model = model
+        self._buckets = tuple(sorted(buckets))
+        self._warmup = warmup
+        self._compiled = None
+        self._device_model_object = None
+        self._ready = False
+
+    def setup(self) -> None:
+        """Decide the execution mode and (if traceable) compile + warm the predictor."""
+        artifact = self._model.artifact
+        if artifact is None:
+            raise RuntimeError("ResidentPredictor.setup requires a loaded model artifact.")
+
+        predictor = self._model._predictor
+        model_object = artifact.model_object
+        if is_jax_compatible(model_object):
+            predictor_fn = getattr(predictor, "fn", predictor)
+            # keep the artifact resident on device: no host->device transfer per request
+            self._device_model_object = jax.tree_util.tree_map(jax.numpy.asarray, model_object)
+            self._compiled = jax.jit(predictor_fn)
+            if self._warmup:
+                self._warm()
+        else:
+            logger.info("Model object is not a jax pytree; serving will run the predictor eagerly.")
+        self._ready = True
+
+    def _warm(self) -> None:
+        """Compile the smallest bucket ahead of the first request."""
+        try:
+            example = self._example_features(self._buckets[0])
+            if example is None:
+                return
+            jax.block_until_ready(self._compiled(self._device_model_object, example))
+            logger.info("Resident predictor warmed (bucket=%d).", self._buckets[0])
+        except Exception as exc:
+            logger.info("Warmup skipped (%s: %s); first request will compile.", type(exc).__name__, exc)
+            self._compiled = None
+
+    def _example_features(self, batch: int) -> Optional[Any]:
+        """Synthesize zero features of bucket shape from the dataset's feature metadata."""
+        n_features = getattr(self._model.dataset, "_features", None)
+        if n_features:
+            return jax.numpy.zeros((batch, len(n_features)), dtype=jax.numpy.float32)
+        return None
+
+    def _bucket_for(self, n: int) -> int:
+        for bucket in self._buckets:
+            if bucket >= n:
+                return bucket
+        # oversize requests round up to a multiple of the largest bucket
+        largest = self._buckets[-1]
+        return ((n + largest - 1) // largest) * largest
+
+    def predict(self, features: Any = None, **reader_kwargs) -> Any:
+        """Request-path prediction; uses the resident executable when possible."""
+        if not self._ready:
+            self.setup()
+        if self._compiled is None or features is None:
+            return self._model.predict(features=features, **reader_kwargs)
+
+        processed = self._model.dataset.get_features(features)
+        if not is_jax_compatible(processed) or not hasattr(processed, "shape"):
+            return self._model.predict(features=features, **reader_kwargs)
+
+        array = np.asarray(processed) if not isinstance(processed, jax.Array) else processed
+        if array.dtype == np.float64:
+            array = array.astype(np.float32)
+        n = array.shape[0]
+        bucket = self._bucket_for(n)
+        if bucket != n:
+            pad = [(0, bucket - n)] + [(0, 0)] * (array.ndim - 1)
+            array = np.pad(np.asarray(array), pad)
+        try:
+            predictions = self._compiled(self._device_model_object, jax.numpy.asarray(array))
+        except Exception as exc:
+            logger.info("Resident predict failed (%s); falling back to eager predict.", exc)
+            self._compiled = None
+            return self._model.predict(features=features, **reader_kwargs)
+        predictions = jax.device_get(predictions)
+        # slice the padding off every batch-shaped leaf (predictor outputs may be pytrees)
+        result = jax.tree_util.tree_map(
+            lambda leaf: leaf[:n]
+            if hasattr(leaf, "shape") and leaf.ndim >= 1 and leaf.shape[0] == bucket
+            else leaf,
+            predictions,
+        )
+        self._model._run_predict_callbacks(self._device_model_object, processed, result)
+        return result

@@ -1,0 +1,295 @@
+"""Stage runtime: the choke point where user functions become executable pipeline stages.
+
+Reference parity: ``unionml/utils.py:11-60`` (``inner_task``) wraps a closure into a
+flytekit task with a synthesized keyword-only signature. Here the same choke point
+produces a :class:`Stage` — a plain Python callable with a typed interface, resource
+request, optional content-hash result caching, and a serializable address
+``(module, variable, stage_name)`` for rehydration in backend workers.
+
+TPU-native addition: :class:`TracedFunction` wraps user ``trainer``/``predictor``/
+``evaluator`` callables as ``jax.jit``-compiled functions (the north-star requirement in
+BASELINE.json). Policy ``"auto"`` traces when the inputs are jax-compatible pytrees and
+falls back to eager execution for opaque model objects (sklearn estimators, torch
+modules), so the same decorator surface serves both compiled-JAX and black-box trainers
+(SURVEY.md §7 "opaque-trainer duality").
+"""
+
+import hashlib
+import inspect
+import os
+import pickle
+import time
+from collections import OrderedDict
+from functools import wraps
+from pathlib import Path
+from typing import Any, Callable, Dict, FrozenSet, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from unionml_tpu._logging import logger
+from unionml_tpu.defaults import DEFAULT_RESOURCES, Resources
+from unionml_tpu.exceptions import StageError
+
+_EMPTY = inspect.Parameter.empty
+
+#: leaf types that can cross the trace boundary as dynamic (traced) values
+_TRACEABLE_LEAVES = (jax.Array, np.ndarray, np.generic, float, int, bool, complex)
+#: leaf types treated as static (compile-time constants) when auto-tracing
+_STATIC_LEAVES = (str, bytes, type(None))
+
+
+def is_jax_compatible(tree: Any) -> bool:
+    """True when every leaf of ``tree`` can participate in a jax trace."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return all(isinstance(leaf, _TRACEABLE_LEAVES) for leaf in leaves)
+
+
+def _scalarize(value: Any) -> Any:
+    """Convert 0-d jax/numpy arrays to python scalars (for metrics dict parity)."""
+    if isinstance(value, (jax.Array, np.ndarray)) and value.ndim == 0:
+        return value.item()
+    return value
+
+
+class TracedFunction:
+    """A user callable with a jit-compilation policy and eager fallback.
+
+    :param fn: the user function.
+    :param jit: ``True`` (always trace; errors surface), ``False`` (never trace), or
+        ``"auto"`` (trace when inputs are jax-compatible; fall back to eager otherwise).
+    :param static_argnames: kwarg names treated as compile-time constants.
+    :param donate_argnums: positional args whose buffers XLA may reuse (HBM savings for
+        the train-step pattern ``params = step(params, batch)``).
+    :param in_shardings / out_shardings: optional sharding annotations forwarded to
+        ``jax.jit`` — this is the pjit path used by the data-parallel engine.
+    """
+
+    def __init__(
+        self,
+        fn: Callable,
+        *,
+        jit: Union[bool, str] = "auto",
+        static_argnames: Sequence[str] = (),
+        donate_argnums: Sequence[int] = (),
+        in_shardings: Any = None,
+        out_shardings: Any = None,
+    ):
+        wraps(fn)(self)
+        self._fn = fn
+        self._policy = jit
+        self._static_argnames = tuple(static_argnames)
+        self._donate_argnums = tuple(donate_argnums)
+        self._in_shardings = in_shardings
+        self._out_shardings = out_shardings
+        self._eager = jit is False
+        self._compiled: Dict[FrozenSet[str], Callable] = {}
+
+    @property
+    def fn(self) -> Callable:
+        return self._fn
+
+    @property
+    def uses_jit(self) -> bool:
+        return not self._eager
+
+    def _auto_static_names(self, kwargs: Mapping[str, Any]) -> Tuple[str, ...]:
+        names = set(self._static_argnames)
+        for key, value in kwargs.items():
+            if isinstance(value, _STATIC_LEAVES) or not is_jax_compatible(value):
+                names.add(key)
+        return tuple(sorted(names))
+
+    def _get_compiled(self, static_names: Tuple[str, ...]) -> Callable:
+        key = frozenset(static_names)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            jit_kwargs: Dict[str, Any] = {"static_argnames": static_names or None}
+            if self._donate_argnums:
+                jit_kwargs["donate_argnums"] = self._donate_argnums
+            if self._in_shardings is not None:
+                jit_kwargs["in_shardings"] = self._in_shardings
+            if self._out_shardings is not None:
+                jit_kwargs["out_shardings"] = self._out_shardings
+            compiled = jax.jit(self._fn, **{k: v for k, v in jit_kwargs.items() if v is not None})
+            self._compiled[key] = compiled
+        return compiled
+
+    def __call__(self, *args, **kwargs):
+        if self._eager:
+            return self._fn(*args, **kwargs)
+
+        if self._policy == "auto" and not is_jax_compatible(args):
+            # opaque model objects (sklearn/torch/keras) can never trace: permanent eager
+            self._eager = True
+            logger.debug("%s: inputs are not jax-compatible; running eagerly.", getattr(self._fn, "__name__", self._fn))
+            return self._fn(*args, **kwargs)
+
+        static_names = self._auto_static_names(kwargs)
+        try:
+            return self._get_compiled(static_names)(*args, **kwargs)
+        except Exception as exc:
+            if self._policy == "auto":
+                self._eager = True
+                logger.info(
+                    "%s: jit tracing failed (%s: %s); falling back to eager execution.",
+                    getattr(self._fn, "__name__", self._fn),
+                    type(exc).__name__,
+                    exc,
+                )
+                return self._fn(*args, **kwargs)
+            raise StageError(f"jit compilation of {self._fn} failed") from exc
+
+
+def _default_cache_root() -> Path:
+    return Path(os.getenv("UNIONML_TPU_HOME", Path.home() / ".unionml-tpu")) / "cache"
+
+
+def _fingerprint(payload: Any) -> str:
+    try:
+        raw = pickle.dumps(payload)
+    except Exception:
+        return ""
+    return hashlib.sha256(raw).hexdigest()
+
+
+class Stage:
+    """An executable pipeline stage with a typed keyword-only interface.
+
+    Stages are the unit the workflow engine wires together and the unit the execution
+    backend ships to workers. A stage's address is ``(app module, tracked variable,
+    stage name)`` — see :mod:`unionml_tpu.tracker`.
+    """
+
+    def __init__(
+        self,
+        fn: Callable,
+        *,
+        name: str,
+        owner: Any = None,
+        inputs: "OrderedDict[str, inspect.Parameter]",
+        output_annotation: Any = _EMPTY,
+        requests: Resources = DEFAULT_RESOURCES,
+        limits: Resources = DEFAULT_RESOURCES,
+        cache: bool = False,
+        cache_version: str = "0",
+        **extra_options: Any,
+    ):
+        self._fn = fn
+        self.name = name
+        self.owner = owner
+        self.inputs: "OrderedDict[str, inspect.Parameter]" = inputs
+        self.output_annotation = output_annotation
+        self.requests = requests
+        self.limits = limits
+        self.cache = cache
+        self.cache_version = cache_version
+        self.options = extra_options
+        self.last_duration: Optional[float] = None
+
+    @property
+    def python_interface(self) -> "StageInterface":
+        return StageInterface(
+            inputs=OrderedDict((k, p.annotation) for k, p in self.inputs.items()),
+            outputs=_output_mapping(self.output_annotation),
+        )
+
+    def _cache_path(self, digest: str) -> Path:
+        safe_name = self.name.replace("/", "_")
+        return _default_cache_root() / safe_name / self.cache_version / f"{digest}.pkl"
+
+    def __call__(self, **kwargs: Any) -> Any:
+        unknown = set(kwargs) - set(self.inputs)
+        if unknown:
+            raise StageError(f"Stage {self.name} received unknown arguments: {sorted(unknown)}")
+
+        digest = ""
+        if self.cache:
+            digest = _fingerprint((self.name, self.cache_version, sorted(kwargs.items(), key=lambda kv: kv[0])))
+            if digest:
+                path = self._cache_path(digest)
+                if path.exists():
+                    logger.debug("Stage %s: cache hit (%s)", self.name, digest[:12])
+                    with path.open("rb") as f:
+                        return pickle.load(f)
+
+        start = time.perf_counter()
+        result = self._fn(**kwargs)
+        self.last_duration = time.perf_counter() - start
+        logger.debug("Stage %s ran in %.4fs", self.name, self.last_duration)
+
+        if self.cache and digest:
+            path = self._cache_path(digest)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            try:
+                with path.open("wb") as f:
+                    pickle.dump(result, f)
+            except Exception as exc:  # unpicklable results simply skip the cache
+                logger.debug("Stage %s: result not cacheable (%s)", self.name, exc)
+        return result
+
+    def __repr__(self) -> str:
+        return f"Stage(name={self.name!r}, inputs={list(self.inputs)}, cache={self.cache})"
+
+
+class StageInterface:
+    """Typed input/output view of a stage (flytekit ``python_interface`` analogue)."""
+
+    def __init__(self, inputs: "OrderedDict[str, Any]", outputs: "OrderedDict[str, Any]"):
+        self.inputs = inputs
+        self.outputs = outputs
+
+
+def _output_mapping(annotation: Any) -> "OrderedDict[str, Any]":
+    """Expose NamedTuple outputs as named fields, everything else as a single output ``o0``."""
+    fields = getattr(annotation, "_fields", None)
+    if fields is not None and hasattr(annotation, "__annotations__"):
+        return OrderedDict((f, annotation.__annotations__.get(f, Any)) for f in fields)
+    return OrderedDict([("o0", annotation)])
+
+
+def stage(
+    fn: Optional[Callable] = None,
+    *,
+    unionml_obj: Any,
+    input_parameters: Optional[Mapping[str, inspect.Parameter]] = None,
+    return_annotation: Any = _EMPTY,
+    **stage_kwargs: Any,
+) -> Union[Callable, Stage]:
+    """Build a :class:`Stage` from a closure defined inside Dataset/Model.
+
+    The synthesized interface is keyword-only, named ``{obj.name}.{fn.__name__}`` —
+    reference parity with ``inner_task`` (``unionml/utils.py:40-60``).
+    """
+    if fn is None:
+        def _bind(inner_fn: Callable) -> Stage:
+            return stage(
+                inner_fn,
+                unionml_obj=unionml_obj,
+                input_parameters=input_parameters,
+                return_annotation=return_annotation,
+                **stage_kwargs,
+            )
+        return _bind
+
+    fn_sig = inspect.signature(fn)
+    params = input_parameters if input_parameters is not None else fn_sig.parameters
+    interface = OrderedDict(
+        (name, p.replace(kind=inspect.Parameter.KEYWORD_ONLY)) for name, p in params.items()
+    )
+    output = fn_sig.return_annotation if return_annotation is _EMPTY else return_annotation
+
+    known = {"requests", "limits", "cache", "cache_version"}
+    core = {k: v for k, v in stage_kwargs.items() if k in known}
+    extra = {k: v for k, v in stage_kwargs.items() if k not in known}
+    built = Stage(
+        fn,
+        name=f"{unionml_obj.name}.{fn.__name__}",
+        owner=unionml_obj,
+        inputs=interface,
+        output_annotation=output,
+        **core,
+        **extra,
+    )
+    return built
