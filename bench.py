@@ -1,0 +1,114 @@
+"""Benchmark: BERT-base fine-tune step throughput (the BASELINE.md headline metric).
+
+Prints exactly ONE JSON line to stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference (unionai-oss/unionml) publishes no performance numbers anywhere
+(BASELINE.md), so the baseline is this framework's own round-1 measurement on a
+v5e chip; ``vs_baseline`` is the ratio current/round-1 (1.0 at the baseline round).
+
+Method: synthetic tokenized batches (seq 128), jit-compiled train step with donated
+state, bfloat16 compute; warmup steps excluded, steady-state examples/s reported.
+All logging goes to stderr; stdout carries only the JSON line.
+"""
+
+import json
+import logging
+import sys
+import time
+
+logging.basicConfig(stream=sys.stderr)
+for noisy in ("jax", "unionml_tpu"):
+    logging.getLogger(noisy).setLevel(logging.WARNING)
+
+#: round-1 v5e-1 measurement (examples/s); later rounds report vs_baseline against it.
+BASELINE_EXAMPLES_PER_S = None
+
+
+def run_bench():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models import (
+        BertConfig,
+        BertForSequenceClassification,
+        create_train_state,
+        init_params,
+    )
+    from unionml_tpu.models.training import bert_flops_per_token, make_classifier_train_step
+
+    backend = jax.default_backend()
+    on_accelerator = backend not in ("cpu",)
+    if on_accelerator:
+        config = BertConfig.base(dtype=jnp.bfloat16)
+        batch_sizes = (32, 16, 8)
+        measure_steps, warmup_steps = 10, 2
+    else:  # keep the CPU path runnable for smoke testing
+        config = BertConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        batch_sizes = (8,)
+        measure_steps, warmup_steps = 5, 1
+
+    seq_len = 128
+    model = BertForSequenceClassification(config)
+    rng = np.random.default_rng(0)
+
+    last_error = None
+    for batch_size in batch_sizes:
+        try:
+            variables = init_params(config, seq_len=seq_len)
+            state = create_train_state(
+                model, variables, learning_rate=2e-5, warmup_steps=10, total_steps=1000
+            )
+            step = make_classifier_train_step(input_signature=("input_ids", "attention_mask"))
+            batch = {
+                "input_ids": jnp.asarray(
+                    rng.integers(0, config.vocab_size, size=(batch_size, seq_len)), dtype=jnp.int32
+                ),
+                "attention_mask": jnp.ones((batch_size, seq_len), dtype=jnp.int32),
+                "labels": jnp.asarray(rng.integers(0, config.num_labels, size=(batch_size,)), dtype=jnp.int32),
+            }
+            for _ in range(warmup_steps):
+                state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+
+            t0 = time.perf_counter()
+            for _ in range(measure_steps):
+                state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            elapsed = time.perf_counter() - t0
+
+            examples_per_s = measure_steps * batch_size / elapsed
+            tokens_per_s = examples_per_s * seq_len
+            flops_per_token = bert_flops_per_token(config)
+            achieved_flops = tokens_per_s * flops_per_token
+            print(
+                f"[bench] backend={backend} batch={batch_size} steps={measure_steps} "
+                f"elapsed={elapsed:.2f}s examples/s={examples_per_s:.1f} "
+                f"tokens/s={tokens_per_s:.0f} ~TFLOP/s={achieved_flops/1e12:.2f}",
+                file=sys.stderr,
+            )
+            return examples_per_s
+        except Exception as exc:  # OOM etc: try a smaller batch
+            last_error = exc
+            print(f"[bench] batch={batch_size} failed: {exc}", file=sys.stderr)
+    raise RuntimeError(f"benchmark failed at all batch sizes: {last_error}")
+
+
+def main():
+    value = run_bench()
+    vs_baseline = value / BASELINE_EXAMPLES_PER_S if BASELINE_EXAMPLES_PER_S else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_finetune_throughput",
+                "value": round(value, 2),
+                "unit": "examples/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Attention kernel + loss op tests (pallas interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.ops.attention import attention, flash_attention, xla_attention
+from unionml_tpu.ops.losses import accuracy, cross_entropy_with_integer_labels
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    shape = (2, 4, 256, 128)
+    return tuple(jnp.asarray(rng.normal(size=shape), dtype=jnp.float32) for _ in range(3))
+
+
+def test_flash_matches_xla_no_mask(qkv):
+    q, k, v = qkv
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, interpret=True)),
+        np.asarray(xla_attention(q, k, v)),
+        atol=1e-5,
+    )
+
+
+def test_flash_matches_xla_padding_mask(qkv):
+    q, k, v = qkv
+    kv_lens = jnp.asarray([130, 256], dtype=jnp.int32)
+    mask = (jnp.arange(256)[None, :] < kv_lens[:, None])[:, None, None, :]
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, kv_lens=kv_lens, interpret=True)),
+        np.asarray(xla_attention(q, k, v, mask=mask)),
+        atol=1e-5,
+    )
+
+
+def test_flash_matches_xla_causal(qkv):
+    q, k, v = qkv
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=True, interpret=True)),
+        np.asarray(xla_attention(q, k, v, causal=True)),
+        atol=1e-5,
+    )
+
+
+def test_flash_gradients_match(qkv):
+    q, k, v = qkv
+    kv_lens = jnp.asarray([200, 256], dtype=jnp.int32)
+    mask = (jnp.arange(256)[None, :] < kv_lens[:, None])[:, None, None, :]
+    g_flash = jax.grad(lambda a, b, c: jnp.sum(flash_attention(a, b, c, kv_lens=kv_lens, interpret=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(xla_attention(a, b, c, mask=mask) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_irregular_shapes_fall_back():
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 2, 100, 64)), dtype=jnp.float32)  # not tile-aligned
+    out = flash_attention(q, q, q, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xla_attention(q, q, q)), atol=1e-5)
+
+
+def test_attention_dispatcher_cpu_uses_xla(qkv):
+    q, k, v = qkv
+    out = attention(q, k, v, impl="auto")  # cpu backend -> xla path
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xla_attention(q, k, v)), atol=1e-6)
+    with pytest.raises(ValueError, match="Unknown attention impl"):
+        attention(q, k, v, impl="nope")
+
+
+def test_cross_entropy_matches_optax():
+    import optax
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(32, 10)), dtype=jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, size=(32,)))
+    ours = cross_entropy_with_integer_labels(logits, labels)
+    ref = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-6)
+
+
+def test_cross_entropy_weights_mask_padding():
+    logits = jnp.asarray([[10.0, 0.0], [0.0, 10.0], [5.0, 5.0]])
+    labels = jnp.asarray([0, 1, 0])
+    weights = jnp.asarray([1.0, 1.0, 0.0])
+    masked = cross_entropy_with_integer_labels(logits, labels, weights)
+    unmasked = cross_entropy_with_integer_labels(logits[:2], labels[:2])
+    np.testing.assert_allclose(float(masked), float(unmasked), rtol=1e-6)
+    assert float(accuracy(logits, labels, weights)) == 1.0

@@ -130,6 +130,7 @@ class LocalBackend:
         self.default_project = project or "default-project"
         self.default_domain = domain or "development"
         self.in_process = in_process
+        self._workers: Dict[str, subprocess.Popen] = {}
         self._base.mkdir(parents=True, exist_ok=True)
 
     # ---------------------------------------------------------------- layout
@@ -281,28 +282,38 @@ class LocalBackend:
                 stderr=subprocess.STDOUT,
                 cwd=os.getcwd(),
             )
+        # keep the handle: poll() both reaps the child (no zombie) and detects crashes
+        self._workers[execution.id] = process
         (execution.directory / "pid").write_text(str(process.pid))
 
-    @staticmethod
-    def _reap_dead_worker(execution: Execution) -> None:
+    def _reap_dead_worker(self, execution: Execution) -> None:
         """Failure detection: mark an execution FAILED if its worker died without a status.
 
         A worker OOM-killed or segfaulted (plausible under XLA memory pressure) never
-        writes SUCCEEDED/FAILED; without this check ``wait`` would spin forever.
+        writes SUCCEEDED/FAILED; without this check ``wait`` would spin forever. Own
+        children are poll()ed (which also reaps the zombie); foreign pids (another
+        client waiting on the same store) are checked via /proc, treating zombie state
+        as dead.
         """
-        pid_file = execution.directory / "pid"
-        if not pid_file.exists():
-            return
-        try:
-            pid = int(pid_file.read_text().strip())
-            os.kill(pid, 0)  # raises if the process is gone
-        except (ValueError, ProcessLookupError):
+        process = self._workers.get(execution.id)
+        if process is not None:
+            if process.poll() is None:
+                return
+            dead = True
+        else:
+            pid_file = execution.directory / "pid"
+            if not pid_file.exists():
+                return
+            try:
+                pid = int(pid_file.read_text().strip())
+            except ValueError:
+                return
+            dead = _pid_dead_or_zombie(pid)
+        if dead and not execution.is_done:
             (execution.directory / "error.txt").write_text(
                 "Worker process exited without reporting a status (killed or crashed)."
             )
             (execution.directory / "status").write_text(_STATUS_FAILED)
-        except PermissionError:  # pragma: no cover - process exists, owned elsewhere
-            pass
 
     def wait(self, execution: Execution, timeout: Optional[float] = None, poll_interval: float = 0.2) -> Execution:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -520,6 +531,19 @@ def backend_from_config(
         elif target not in ("local", "sandbox"):
             raise BackendError(f"Unknown backend target {target!r}; expected 'local', 'sandbox', or 'local://<path>'")
     return LocalBackend(root=root, project=project, domain=domain, in_process=in_process)
+
+
+def _pid_dead_or_zombie(pid: int) -> bool:
+    """True when ``pid`` no longer runs (missing from /proc or in zombie state)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            # field 3 (after the parenthesized comm, which may contain spaces)
+            state = f.read().rsplit(")", 1)[1].split()[0]
+        return state == "Z"
+    except (FileNotFoundError, ProcessLookupError, IndexError):
+        return True
+    except OSError:  # pragma: no cover - /proc unavailable: assume alive
+        return False
 
 
 def _plain_inputs(inputs: Dict[str, Any]) -> Dict[str, Any]:

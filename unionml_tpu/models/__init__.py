@@ -1,0 +1,43 @@
+"""Model zoo: jax-native models the framework owns end-to-end.
+
+The reference owns no models (users bring sklearn/torch/keras callables); here the
+digits/MNIST/BERT baseline configs ship as compiled flax modules with train steps,
+shardings, and checkpointing (BASELINE.md configs 1-4).
+"""
+
+from unionml_tpu.models.bert import (
+    BertConfig,
+    BertForSequenceClassification,
+    BertModel,
+    import_hf_weights,
+    init_params,
+    param_shardings,
+)
+from unionml_tpu.models.mlp import CNNClassifier, MLPClassifier
+from unionml_tpu.models.training import (
+    FitResult,
+    TrainState,
+    create_train_state,
+    dict_batches,
+    fit,
+    make_classifier_eval_step,
+    make_classifier_train_step,
+)
+
+__all__ = [
+    "BertConfig",
+    "BertForSequenceClassification",
+    "BertModel",
+    "CNNClassifier",
+    "FitResult",
+    "MLPClassifier",
+    "TrainState",
+    "create_train_state",
+    "dict_batches",
+    "fit",
+    "import_hf_weights",
+    "init_params",
+    "make_classifier_eval_step",
+    "make_classifier_train_step",
+    "param_shardings",
+]

@@ -1,0 +1,50 @@
+"""Small dense models: MLP classifier (digits quickstart) and CNN (MNIST recipe).
+
+These are the jax-native counterparts of the reference's sklearn/pytorch/keras digits
+MLPs (``tests/integration/pytorch_app/quickstart.py``, ``keras_app/quickstart.py``):
+same configs (hidden sizes, batch 512-style training) but compiled end-to-end.
+"""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLPClassifier(nn.Module):
+    """Dense ReLU stack with a linear head; logits out."""
+
+    hidden_sizes: Sequence[int] = (128,)
+    num_classes: int = 10
+    dtype: object = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, size in enumerate(self.hidden_sizes):
+            x = nn.Dense(size, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+class CNNClassifier(nn.Module):
+    """Conv -> pool x2 -> dense head (the Keras-MNIST tutorial shape, compiled)."""
+
+    num_classes: int = 10
+    dtype: object = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype, name="conv_0")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype, name="conv_1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype, name="dense")(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

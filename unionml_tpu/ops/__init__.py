@@ -1,0 +1,17 @@
+"""Hot ops owned by the framework: attention kernels and fused losses."""
+
+from unionml_tpu.ops.attention import attention, flash_attention, xla_attention
+from unionml_tpu.ops.losses import (
+    accuracy,
+    cross_entropy_and_accuracy,
+    cross_entropy_with_integer_labels,
+)
+
+__all__ = [
+    "accuracy",
+    "attention",
+    "cross_entropy_and_accuracy",
+    "cross_entropy_with_integer_labels",
+    "flash_attention",
+    "xla_attention",
+]

@@ -1,0 +1,258 @@
+"""Attention ops: pallas flash-attention TPU kernel with an XLA fallback.
+
+The reference delegates all math to user frameworks (SURVEY.md §2: "no CUDA/C++
+anywhere"); in the TPU rebuild the attention hot op is owned by the framework. Two
+implementations behind one dispatcher:
+
+- ``impl="pallas"``: blocked flash attention (online softmax) keeping the working set
+  in VMEM, f32 accumulation on the MXU, O(seq) memory. Grid: (batch*heads, q_blocks);
+  the KV scan runs inside the kernel with ``jax.lax.fori_loop``.
+- ``impl="xla"``: the standard fused-by-XLA softmax(QK^T)V — also the backward path of
+  the pallas forward (rematerialized), so autodiff works everywhere.
+- ``impl="auto"``: pallas on TPU backends, XLA elsewhere (CPU tests run the fallback).
+
+Shapes follow the (batch, num_heads, seq, head_dim) convention.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: default block sizes — multiples of the MXU/VPU tile (128 lanes)
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+_NEG_INF = -1e30
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference attention; XLA fuses the softmax chain. Used as fallback + backward."""
+    *_, seq_q, head_dim = q.shape
+    seq_k = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(head_dim)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool))
+        logits = jnp.where(causal_mask[None, None], logits, _NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask, logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+def _flash_kernel(
+    kv_len_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    *,
+    block_k: int,
+    seq_k: int,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+):
+    """One (batch*head, q_block) program: stream KV blocks with an online softmax.
+
+    ``kv_len_ref`` is a scalar (SMEM) per-batch valid KV length implementing the
+    padding mask: K positions >= kv_len contribute nothing.
+    """
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, head_dim)
+    q_index = pl.program_id(1)
+    kv_len = kv_len_ref[0]
+
+    acc = jnp.zeros((block_q, q.shape[-1]), dtype=jnp.float32)
+    row_max = jnp.full((block_q, 1), _NEG_INF, dtype=jnp.float32)
+    row_sum = jnp.zeros((block_q, 1), dtype=jnp.float32)
+
+    num_k_blocks = seq_k // block_k
+
+    def body(k_idx, carry):
+        acc, row_max, row_sum = carry
+        k_block = k_ref[0, pl.ds(k_idx * block_k, block_k), :].astype(jnp.float32)
+        v_block = v_ref[0, pl.ds(k_idx * block_k, block_k), :].astype(jnp.float32)
+
+        scores = jax.lax.dot_general(
+            q, k_block, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+
+        k_pos = k_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = q_index * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        scores = jnp.where(valid, scores, _NEG_INF)
+
+        new_max = jnp.maximum(row_max, jnp.max(scores, axis=-1, keepdims=True))
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max)
+        acc = acc * correction + jax.lax.dot_general(
+            probs, v_block, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        row_sum = row_sum * correction + jnp.sum(probs, axis=-1, keepdims=True)
+        return acc, new_max, row_sum
+
+    # bound the scan: skip fully-masked KV blocks (padding tail; causal upper triangle)
+    last_block = jnp.minimum(num_k_blocks, pl.cdiv(kv_len, block_k))
+    if causal:
+        last_block = jnp.minimum(last_block, (q_index + 1) * block_q // block_k + 1)
+    acc, row_max, row_sum = jax.lax.fori_loop(0, last_block, body, (acc, row_max, row_sum))
+    o_ref[0] = (acc / jnp.maximum(row_sum, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_lens: Optional[jax.Array],
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[-2]
+
+    # irregular shapes fall back to XLA for exactness; head_dim down to 64 is allowed
+    # (mosaic pads the lane dim), smaller/odd head dims are not worth the kernel
+    if seq_q % block_q or seq_k % block_k or head_dim % 64:
+        mask = _kv_lens_to_mask(kv_lens, seq_k) if kv_lens is not None else None
+        return xla_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+
+    bh = batch * heads
+    q3 = q.reshape(bh, seq_q, head_dim)
+    k3 = k.reshape(bh, seq_k, head_dim)
+    v3 = v.reshape(bh, seq_k, head_dim)
+    if kv_lens is None:
+        kv_lens = jnp.full((batch,), seq_k, dtype=jnp.int32)
+    kv_lens_bh = jnp.repeat(kv_lens.astype(jnp.int32), heads)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        block_k=block_k,
+        seq_k=seq_k,
+        causal=causal,
+        sm_scale=sm_scale,
+        block_q=block_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, i: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * seq_q * seq_k * head_dim,
+            bytes_accessed=(q3.size + k3.size + v3.size + q3.size) * q3.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
+        ),
+        interpret=interpret,
+    )(kv_lens_bh, q3, k3, v3)
+    return out.reshape(batch, heads, seq_q, head_dim)
+
+
+def _kv_lens_to_mask(kv_lens: jax.Array, seq_k: int) -> jax.Array:
+    """(batch,) valid lengths -> (batch, 1, 1, seq_k) boolean padding mask."""
+    positions = jnp.arange(seq_k)[None, :]
+    return (positions < kv_lens[:, None])[:, None, None, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_lens: Optional[jax.Array] = None,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blocked flash attention (pallas). Differentiable: backward rematerializes via XLA.
+
+    :param kv_lens: optional (batch,) int32 valid KV lengths — the padding-mask case
+        (keys at positions >= kv_lens[b] are masked for every head/query of batch b).
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    return _flash_forward(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, kv_lens)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+    q, k, v, kv_lens = residuals
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    mask = _kv_lens_to_mask(kv_lens, k.shape[-2]) if kv_lens is not None else None
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: xla_attention(q_, k_, v_, mask=mask, causal=causal, sm_scale=scale), q, k, v
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _on_tpu() -> bool:
+    """True only for genuine TPU devices (incl. remote-TPU plugin backends)."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        return "tpu" in jax.devices()[0].device_kind.lower()
+    except Exception:  # pragma: no cover - backend without device_kind
+        return False
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    kv_lens: Optional[jax.Array] = None,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching attention entrypoint used by the model zoo.
+
+    ``impl="auto"`` picks the pallas kernel on TPU (dense ``mask`` arrays force XLA —
+    the kernel handles the causal and per-batch-length padding cases) and the XLA path
+    elsewhere.
+    """
+    if impl == "auto":
+        impl = "pallas" if (_on_tpu() and mask is None) else "xla"
+    if impl == "pallas":
+        if mask is not None:
+            raise ValueError(
+                "attention(impl='pallas') does not support dense masks; pass kv_lens "
+                "(right-padding) / causal, or use impl='xla' for arbitrary masks."
+            )
+        return flash_attention(q, k, v, kv_lens, causal, sm_scale)
+    if impl == "xla":
+        if mask is None and kv_lens is not None:
+            mask = _kv_lens_to_mask(kv_lens, k.shape[-2])
+        return xla_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+    raise ValueError(f"Unknown attention impl {impl!r}; expected 'auto', 'pallas', or 'xla'")

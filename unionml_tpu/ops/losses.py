@@ -1,0 +1,49 @@
+"""Fused loss ops: numerically stable cross-entropy with integer labels.
+
+Written so XLA fuses the logsumexp chain into the final matmul's epilogue; keeps
+logits in f32 regardless of the (bfloat16) compute dtype — the standard TPU mixed-
+precision recipe.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_with_integer_labels(
+    logits: jax.Array,
+    labels: jax.Array,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mean (optionally weighted) softmax cross-entropy; labels are class indices.
+
+    ``weights`` masks out entries (e.g. padding) and normalizes by total weight.
+    """
+    logits = logits.astype(jnp.float32)
+    log_z = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    losses = log_z - label_logits
+    if weights is not None:
+        weights = weights.astype(jnp.float32)
+        # epsilon guards only the all-zero case; fractional weight sums stay exact
+        return jnp.sum(losses * weights) / jnp.maximum(jnp.sum(weights), 1e-8)
+    return jnp.mean(losses)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array, weights: Optional[jax.Array] = None) -> jax.Array:
+    predictions = jnp.argmax(logits, axis=-1)
+    correct = (predictions == labels).astype(jnp.float32)
+    if weights is not None:
+        weights = weights.astype(jnp.float32)
+        return jnp.sum(correct * weights) / jnp.maximum(jnp.sum(weights), 1e-8)
+    return jnp.mean(correct)
+
+
+def cross_entropy_and_accuracy(
+    logits: jax.Array, labels: jax.Array, weights: Optional[jax.Array] = None
+) -> Tuple[jax.Array, jax.Array]:
+    return (
+        cross_entropy_with_integer_labels(logits, labels, weights),
+        accuracy(logits, labels, weights),
+    )

@@ -119,10 +119,11 @@ def build_aiohttp_app(
                     predictor.predict(**inputs) if predictor is not None else model.predict(**inputs)
                 )
             else:
+                # model.predict runs the feature pipeline itself; don't pre-process here
                 result = (
                     predictor.predict(features=features)
                     if predictor is not None
-                    else model.predict(features=model.dataset.get_features(features))
+                    else model.predict(features=features)
                 )
             return web.json_response(jsonable(result))
         except Exception as exc:

@@ -45,10 +45,11 @@ def attach_fastapi(
         if inputs:
             result = predictor.predict(**inputs) if predictor is not None else model.predict(**inputs)
         else:
+            # model.predict runs the feature pipeline itself; don't pre-process here
             result = (
                 predictor.predict(features=features)
                 if predictor is not None
-                else model.predict(features=model.dataset.get_features(features))
+                else model.predict(features=features)
             )
         return jsonable(result)
 

@@ -66,8 +66,9 @@ class ResidentPredictor:
             jax.block_until_ready(self._compiled(self._device_model_object, example))
             logger.info("Resident predictor warmed (bucket=%d).", self._buckets[0])
         except Exception as exc:
+            # keep the compiled predictor: the synthetic example may simply have the
+            # wrong dtype/shape for this model; the first real request still compiles
             logger.info("Warmup skipped (%s: %s); first request will compile.", type(exc).__name__, exc)
-            self._compiled = None
 
     def _example_features(self, batch: int) -> Optional[Any]:
         """Synthesize zero features of bucket shape from the dataset's feature metadata."""
