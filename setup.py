@@ -1,0 +1,35 @@
+"""Packaging for unionml-tpu.
+
+Reference parity: the console-script pattern of the reference's setup.py
+(``unionml = unionml.cli:app``) — here ``unionml-tpu = unionml_tpu.cli:main``.
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="unionml-tpu",
+    version="0.1.0",
+    description="TPU-native ML microservice framework: train, serve, and deploy compiled models",
+    packages=find_packages(include=["unionml_tpu", "unionml_tpu.*"]),
+    include_package_data=True,
+    package_data={"unionml_tpu": ["templates/**/*"]},
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "flax",
+        "optax",
+        "orbax-checkpoint",
+        "numpy",
+        "pandas",
+        "joblib",
+        "click",
+        "aiohttp",
+        "pyyaml",
+    ],
+    extras_require={
+        "sklearn": ["scikit-learn"],
+        "fastapi": ["fastapi", "uvicorn"],
+        "torch": ["torch"],
+    },
+    entry_points={"console_scripts": ["unionml-tpu = unionml_tpu.cli:main"]},
+)

@@ -1,0 +1,43 @@
+"""A real importable app module: the backend worker rehydrates it by name.
+
+This plays the role of the reference's integration app packages
+(``tests/integration/sklearn_app/quickstart.py``): the worker subprocess imports
+``tests.integration.backend_app`` and finds ``model`` by variable name.
+"""
+
+from typing import List
+
+import numpy as np
+import pandas as pd
+from sklearn.linear_model import LogisticRegression
+
+from unionml_tpu import Dataset, Model
+
+dataset = Dataset(name="backend_dataset", targets=["y"], test_size=0.2)
+model = Model(name="backend_model", init=LogisticRegression, dataset=dataset)
+
+
+@dataset.reader
+def reader(n: int = 80, random_state: int = 0) -> pd.DataFrame:
+    rng = np.random.default_rng(random_state)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    return pd.DataFrame({"x1": x1, "x2": x2, "y": (x1 + x2 > 0).astype(int)})
+
+
+@model.trainer
+def trainer(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+    return estimator.fit(features, target.squeeze())
+
+
+@model.predictor
+def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> List[float]:
+    return [float(x) for x in estimator.predict(features)]
+
+
+@model.evaluator
+def evaluator(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
+    return float(estimator.score(features, target.squeeze()))
+
+
+model.schedule_training("nightly-train", expression="@daily", hyperparameters={"max_iter": 200}, n=40)

@@ -1,0 +1,124 @@
+"""Backend lifecycle integration: deploy -> remote train/predict -> schedules.
+
+The local backend + subprocess worker is the sandbox standing in for a remote TPU
+fleet — the analogue of the reference's dockerized Flyte demo cluster lifecycle test
+(``tests/integration/test_flyte_remote.py:140-183``): deploy, remote train, artifact
+assertions, version listing, schedule deploy/activation, scheduled runs.
+"""
+
+import datetime
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture()
+def app_model(tmp_path, monkeypatch):
+    # the worker subprocess inherits this env: repo-root imports, CPU-only jax
+    monkeypatch.setenv("PYTHONPATH", str(REPO_ROOT))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path))
+    monkeypatch.chdir(REPO_ROOT)
+
+    from tests.integration.backend_app import model
+    from unionml_tpu.backend import LocalBackend
+
+    backend = LocalBackend(root=tmp_path / "backend")
+    model.remote(backend, accelerator="v5litepod-8", topology="2x4")
+    model._artifact = None
+    return model, backend
+
+
+def test_full_remote_lifecycle(app_model):
+    model, backend = app_model
+
+    # deploy with an explicit version (git-sha versioning covered separately)
+    version = model.remote_deploy(app_version="v-test-1")
+    assert version == "v-test-1"
+    spec = backend.fetch_workflow_spec("backend_model.train", "v-test-1")
+    assert spec["app_module"] == "tests.integration.backend_app"
+    assert spec["app_variable"] == "model"
+    assert spec["resources"]["accelerator"] == "v5litepod-8"
+    assert "gpu" not in str(spec["resources"]).lower()
+
+    # remote train through a real worker subprocess (module rehydration boundary)
+    artifact = model.remote_train(
+        app_version="v-test-1", hyperparameters={"max_iter": 200}, n=60, wait=True
+    )
+    assert artifact is not None
+    assert set(artifact.metrics) == {"train", "test"}
+    assert artifact.metrics["test"] > 0.7
+
+    versions = model.remote_list_model_versions()
+    assert len(versions) == 1
+
+    # remote predict with the stored model artifact
+    predictions = model.remote_predict(app_version="v-test-1", n=20, wait=True)
+    assert len(predictions) == 20
+    assert model.remote_list_prediction_ids()
+
+    # predict from features goes through the features workflow
+    features = [{"x1": 1.0, "x2": 1.0}, {"x1": -2.0, "x2": -2.0}]
+    predictions = model.remote_predict(app_version="v-test-1", features=features, wait=True)
+    assert predictions == [1.0, 0.0]
+
+
+def test_remote_train_no_wait_returns_execution(app_model):
+    model, backend = app_model
+    model.remote_deploy(app_version="v-test-2")
+    execution = model.remote_train(app_version="v-test-2", hyperparameters={"max_iter": 100}, wait=False)
+    assert not execution.id.startswith("?")
+    execution = model.remote_wait(execution, timeout=60)
+    assert execution.status == "SUCCEEDED"
+    model.remote_load(execution)
+    assert model.artifact is not None
+    fetched = model.remote_fetch_model(execution)
+    assert fetched.metrics == model.artifact.metrics
+
+
+def test_schedules_deploy_activate_and_fire(app_model):
+    model, backend = app_model
+    model.remote_deploy(app_version="v-sched-1", schedule=True)
+
+    records = {r["name"]: r for r in backend.list_schedules()}
+    assert "nightly-train" in records
+    assert records["nightly-train"]["active"] is True  # activate_on_deploy default
+
+    model.remote_deactivate_schedules(app_version="v-sched-1")
+    assert backend.list_schedules()[0]["active"] is False
+    model.remote_activate_schedules(app_version="v-sched-1")
+    assert backend.list_schedules()[0]["active"] is True
+
+    # drive the scheduler loop deterministically: first tick arms, second tick fires
+    from unionml_tpu.backend import Scheduler
+
+    scheduler = Scheduler(backend)
+    t0 = datetime.datetime(2026, 7, 1, 10, 0)
+    assert scheduler.tick(now=t0) == []
+    fired = scheduler.tick(now=datetime.datetime(2026, 7, 2, 0, 1))
+    assert len(fired) == 1
+    execution = backend.wait(fired[0], timeout=120)
+    assert execution.status == "SUCCEEDED"
+
+    runs = model.remote_list_scheduled_training_runs("nightly-train")
+    assert [e.id for e in runs] == [fired[0].id]
+    with pytest.raises(ValueError, match="does not exist"):
+        model.remote_list_scheduled_training_runs("missing-schedule")
+
+
+def test_failed_worker_surfaces_error(app_model):
+    model, backend = app_model
+    model.remote_deploy(app_version="v-fail-1")
+    from unionml_tpu.exceptions import BackendError
+
+    # a reader kwarg the reader rejects -> worker fails and records the error
+    execution = backend.execute(
+        model, "backend_model.train", inputs={"hyperparameters": {}, "bogus_arg": 1}, app_version="v-fail-1"
+    )
+    with pytest.raises(BackendError, match="failed"):
+        backend.wait(execution, timeout=60)
+    assert execution.error

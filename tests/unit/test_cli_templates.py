@@ -1,0 +1,131 @@
+"""CLI + template tests (click CliRunner; rendered apps must import and train)."""
+
+import json
+import py_compile
+import sys
+from pathlib import Path
+
+import pytest
+from click.testing import CliRunner
+
+from unionml_tpu.cli import app as cli_app
+from unionml_tpu.templates import list_templates, render_template
+
+
+def test_list_templates():
+    assert set(list_templates()) >= {"basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel"}
+
+
+@pytest.mark.parametrize("template", ["basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel"])
+def test_render_template_compiles(template, tmp_path):
+    target = render_template(template, "my_app", tmp_path)
+    app_py = target / "app.py"
+    assert app_py.exists()
+    content = app_py.read_text()
+    assert "{{app_name}}" not in content
+    assert "my_app" in content
+    py_compile.compile(str(app_py), doraise=True)
+    assert (target / ".git").exists()  # app versioning needs a git repo
+
+
+def test_render_template_validations(tmp_path):
+    with pytest.raises(ValueError, match="identifier"):
+        render_template("basic", "bad-name", tmp_path)
+    with pytest.raises(ValueError, match="Unknown template"):
+        render_template("nope", "ok_name", tmp_path)
+    render_template("basic", "dup", tmp_path)
+    with pytest.raises(FileExistsError):
+        render_template("basic", "dup", tmp_path)
+
+
+def test_cli_init_and_templates_cmd(tmp_path, monkeypatch):
+    runner = CliRunner()
+    monkeypatch.chdir(tmp_path)
+    result = runner.invoke(cli_app, ["init", "demo_app", "--template", "basic"])
+    assert result.exit_code == 0, result.output
+    assert (tmp_path / "demo_app" / "app.py").exists()
+
+    result = runner.invoke(cli_app, ["templates"])
+    assert result.exit_code == 0
+    assert "basic" in result.output
+
+    result = runner.invoke(cli_app, ["init", "demo_app2", "--template", "nonexistent"])
+    assert result.exit_code != 0
+    assert "unknown template" in result.output
+
+
+def test_cli_local_train_and_predict(tmp_path, monkeypatch):
+    """End-to-end CLI flow on the mnist-cnn synthetic template (fast, no sklearn data)."""
+    runner = CliRunner()
+    monkeypatch.chdir(tmp_path)
+    render_template("mnist-cnn", "cli_app_t", tmp_path)
+    monkeypatch.chdir(tmp_path / "cli_app_t")
+    monkeypatch.syspath_prepend(str(tmp_path / "cli_app_t"))
+
+    result = runner.invoke(
+        cli_app,
+        [
+            "train",
+            "app:model",
+            "--local",
+            "--inputs",
+            json.dumps({"n": 64, "trainer_kwargs": {"num_epochs": 1, "batch_size": 32}}),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    payload = json.loads(result.output.strip().splitlines()[-1])
+    assert "train" in payload["metrics"]
+
+    result = runner.invoke(cli_app, ["train", "app:model", "--local", "--inputs", "{bad json"])
+    assert result.exit_code != 0
+    assert "must be valid JSON" in result.output
+
+
+def test_cli_remote_roundtrip(tmp_path, monkeypatch):
+    """CLI deploy -> train -> list/fetch against the local backend sandbox."""
+    monkeypatch.setenv("PYTHONPATH", str(Path(__file__).resolve().parents[2]))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path))
+    repo_root = Path(__file__).resolve().parents[2]
+    monkeypatch.chdir(repo_root)
+
+    from tests.integration.backend_app import model
+    from unionml_tpu.backend import LocalBackend
+
+    model.remote(LocalBackend(root=tmp_path / "backend"))
+    model._artifact = None
+
+    runner = CliRunner()
+    result = runner.invoke(
+        cli_app, ["deploy", "tests.integration.backend_app:model", "--app-version", "cli-v1"]
+    )
+    assert result.exit_code == 0, result.output
+    # the CLI re-imported the module; re-point its backend at our tmp store
+    from tests.integration.backend_app import model as model2
+
+    model2.remote(LocalBackend(root=tmp_path / "backend"))
+
+    result = runner.invoke(
+        cli_app,
+        [
+            "train",
+            "tests.integration.backend_app:model",
+            "--wait",
+            "--app-version",
+            "cli-v1",
+            "--inputs",
+            json.dumps({"hyperparameters": {"max_iter": 150}, "n": 50}),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+
+    result = runner.invoke(cli_app, ["list-model-versions", "tests.integration.backend_app:model"])
+    assert result.exit_code == 0 and result.output.strip()
+
+    out_file = tmp_path / "fetched.joblib"
+    result = runner.invoke(
+        cli_app,
+        ["fetch-model", "tests.integration.backend_app:model", "-o", str(out_file)],
+    )
+    assert result.exit_code == 0, result.output
+    assert out_file.exists()

@@ -119,7 +119,6 @@ def test_bert_fit_step_runs_sharded(tiny_config):
 
     from unionml_tpu.models.training import make_classifier_train_step
 
-    spec = jax.tree_util.tree_map(lambda _: None, state)  # placeholder; replicate state
     step = make_classifier_train_step(
         mesh=mesh, input_signature=("input_ids", "attention_mask")
     )

@@ -32,6 +32,8 @@ import sys
 import threading
 import time
 import uuid
+
+import numpy as np
 from dataclasses import asdict, dataclass, is_dataclass
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -299,6 +301,7 @@ class LocalBackend:
         if process is not None:
             if process.poll() is None:
                 return
+            self._workers.pop(execution.id, None)  # exited: drop the handle
             dead = True
         else:
             pid_file = execution.directory / "pid"
@@ -534,31 +537,87 @@ def backend_from_config(
 
 
 def _pid_dead_or_zombie(pid: int) -> bool:
-    """True when ``pid`` no longer runs (missing from /proc or in zombie state)."""
+    """True when ``pid`` no longer runs (gone, or a zombie awaiting reaping)."""
+    if os.path.isdir("/proc"):
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                # field 3 (after the parenthesized comm, which may contain spaces)
+                state = f.read().rsplit(")", 1)[1].split()[0]
+            return state == "Z"
+        except (FileNotFoundError, ProcessLookupError, IndexError):
+            return True
+        except OSError:  # pragma: no cover - unreadable entry: assume alive
+            return False
+    # no procfs (macOS/BSD): signal-0 probe — cannot see zombies, but those only
+    # arise for our own children, which are handled via Popen.poll()
     try:
-        with open(f"/proc/{pid}/stat") as f:
-            # field 3 (after the parenthesized comm, which may contain spaces)
-            state = f.read().rsplit(")", 1)[1].split()[0]
-        return state == "Z"
-    except (FileNotFoundError, ProcessLookupError, IndexError):
+        os.kill(pid, 0)
+        return False
+    except ProcessLookupError:
         return True
-    except OSError:  # pragma: no cover - /proc unavailable: assume alive
+    except PermissionError:  # pragma: no cover - alive, owned elsewhere
         return False
 
 
-def _plain_inputs(inputs: Dict[str, Any]) -> Dict[str, Any]:
-    """Convert synthesized kwargs dataclasses to plain dicts for pickling across processes.
+_STATE_MARKER = "__unionml_state_dict__"
 
-    Dynamically created dataclass types can't unpickle in a fresh worker process, so the
-    wire format is plain dicts; the workflow engine accepts both.
+
+def wire_encode_value(value: Any, hyperparameters: Any = None) -> Any:
+    """Encode one value for cross-process transport.
+
+    Three tiers (the type-engine replacement — SURVEY.md §7 "hard parts"):
+
+    1. synthesized kwargs dataclasses -> plain dicts (their types don't exist in a
+       fresh process);
+    2. picklable values pass through;
+    3. unpicklable pytrees (e.g. flax ``TrainState`` whose optax transform holds
+       closures) -> flax state dict of host arrays + the hyperparameters needed to
+       rebuild the structural template via the app's ``init`` on the other side.
     """
-    plain = {}
-    for key, value in inputs.items():
-        if is_dataclass(value) and not isinstance(value, type):
-            plain[key] = asdict(value)
-        else:
-            plain[key] = value
-    return plain
+    if is_dataclass(value) and not isinstance(value, type) and hasattr(type(value), "from_dict"):
+        # synthesized kwargs/hyperparameter dataclasses: plain-dict wire format
+        return asdict(value)
+
+    def state_encode():
+        from unionml_tpu._logging import logger
+        from unionml_tpu.checkpoint import extract_state, pytree_to_host
+
+        hp = asdict(hyperparameters) if is_dataclass(hyperparameters) else hyperparameters
+        if hp is None:
+            logger.warning(
+                "Encoding a non-picklable model object without hyperparameters; the "
+                "receiving side rebuilds its structure via init() defaults."
+            )
+        return {_STATE_MARKER: pytree_to_host(extract_state(value)), "hyperparameters": hp}
+
+    # flax struct dataclasses (TrainState etc.) always carry unpicklable static fields:
+    # skip the (expensive, always-failing) pickle probe
+    if is_dataclass(value) and not isinstance(value, type) and hasattr(value, "replace"):
+        return state_encode()
+    # scalars / arrays / strings are trivially picklable: skip the probe entirely
+    if value is None or isinstance(value, (bool, int, float, str, bytes, np.ndarray, np.generic)):
+        return value
+    try:
+        pickle.dumps(value)
+        return value
+    except Exception:
+        return state_encode()
+
+
+def wire_decode_value(value: Any, model: Any) -> Any:
+    """Rebuild a state-dict-encoded model object using the app's init slot."""
+    if isinstance(value, dict) and _STATE_MARKER in value:
+        from unionml_tpu.checkpoint import restore_state
+
+        target = model._init_model_object(value.get("hyperparameters") or {})
+        return restore_state(target, value[_STATE_MARKER])
+    return value
+
+
+def _plain_inputs(inputs: Dict[str, Any], hyperparameters: Any = None) -> Dict[str, Any]:
+    """Encode every entry of an inputs/outputs mapping for transport."""
+    hp = hyperparameters if hyperparameters is not None else inputs.get("hyperparameters")
+    return {key: wire_encode_value(value, hp) for key, value in inputs.items()}
 
 
 def _now_iso() -> str:

@@ -50,13 +50,20 @@ def _coerce_inputs(workflow, inputs: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def run_workflow_for_model(model: Any, workflow_name: str, inputs: Dict[str, Any]) -> Dict[str, Any]:
-    """Execute a named workflow and map positional results to named outputs."""
+    """Execute a named workflow and map positional results to named outputs.
+
+    Inputs are wire-decoded (state-dict-encoded model objects rebuilt via the app's
+    init) and outputs wire-encoded back — see ``unionml_tpu.backend.wire_encode_value``.
+    """
+    from unionml_tpu.backend import _plain_inputs, wire_decode_value
+
     workflow = _resolve_workflow(model, workflow_name)
+    inputs = {key: wire_decode_value(value, model) for key, value in inputs.items()}
     result = workflow(**_coerce_inputs(workflow, inputs))
     names = workflow.output_names
     if len(names) == 1:
-        return {names[0]: result}
-    return dict(zip(names, result))
+        return _plain_inputs({names[0]: result})
+    return _plain_inputs(dict(zip(names, result)))
 
 
 def run_execution(execution_dir: Path) -> int:

@@ -46,20 +46,68 @@ def pytree_to_host(tree: Any) -> Any:
     )
 
 
+def extract_state(obj: Any) -> Any:
+    """Pure-data state of a model object (arrays only; no callables/transform objects).
+
+    For flax struct dataclasses (``TrainState`` etc.) only pytree-node fields are kept
+    — static fields like ``apply_fn``/``tx`` hold closures that neither pickle nor
+    belong in a checkpoint; they are rebuilt by the app's ``init`` at restore time.
+    """
+    import dataclasses
+
+    from flax import serialization
+
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: serialization.to_state_dict(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+            if f.metadata.get("pytree_node", True)
+        }
+    return serialization.to_state_dict(obj)
+
+
+def restore_state(target: Any, state: Any) -> Any:
+    """Inverse of :func:`extract_state`: restore data into ``target``'s structure."""
+    import dataclasses
+
+    from flax import serialization
+
+    if dataclasses.is_dataclass(target) and not isinstance(target, type):
+        updates = {
+            f.name: serialization.from_state_dict(getattr(target, f.name), state[f.name])
+            for f in dataclasses.fields(target)
+            if f.metadata.get("pytree_node", True) and f.name in state
+        }
+        if hasattr(target, "replace"):
+            return target.replace(**updates)
+        return dataclasses.replace(target, **updates)
+    return serialization.from_state_dict(target, state)
+
+
 def save_pytree(tree: Any, file: FileLike, hyperparameters: Optional[dict] = None) -> FileLike:
-    """Serialize a pytree (+hyperparameters) to a file or file-like object."""
+    """Serialize a pytree (+hyperparameters) to a file or file-like object.
+
+    Stored as a flax *state dict* of host arrays rather than a pickled object: pytree
+    containers like ``TrainState`` carry unpicklable static fields (optax transform
+    closures, bound apply_fns); the state dict is pure data and restores into a
+    structural template rebuilt by the app's ``init`` (see ``default_load``).
+    """
     payload = {
         _FORMAT_KEY: "pytree",
-        "model_obj": pytree_to_host(tree),
+        "model_obj": pytree_to_host(extract_state(tree)),
         "hyperparameters": hyperparameters,
     }
     joblib.dump(payload, file)
     return file
 
 
-def load_pytree(file: FileLike) -> Any:
+def load_pytree(file: FileLike, target: Any = None) -> Any:
+    """Load a pytree state dict; restores into ``target``'s structure when given."""
     payload = joblib.load(file)
-    return payload["model_obj"]
+    state = payload["model_obj"]
+    if target is not None:
+        return restore_state(target, state)
+    return state
 
 
 def default_save(
@@ -115,6 +163,12 @@ def default_load(
 
     # joblib formats (sklearn, pytree) self-describe via the embedded format tag
     payload = joblib.load(file)
+    if isinstance(payload, dict) and payload.get(_FORMAT_KEY) == "pytree":
+        state = payload["model_obj"]
+        if init_fn is not None:
+            target = init_fn(payload.get("hyperparameters") or {})
+            return restore_state(target, state)
+        return state
     if isinstance(payload, dict) and _FORMAT_KEY in payload:
         return payload["model_obj"]
     if isinstance(payload, dict) and "model_obj" in payload:

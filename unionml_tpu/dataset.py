@@ -243,7 +243,14 @@ class Dataset(TrackedInstance):
     def get_features(self, features: Any) -> Any:
         """Run raw features through feature_loader -> feature_transformer (``dataset.py:350-359``)."""
         features = self._feature_loader(features)
-        features = self._feature_transformer(features)
+        return self.finalize_features(self._feature_transformer(features))
+
+    def finalize_features(self, features: Any) -> Any:
+        """Apply the device-format conversion to transformed features.
+
+        Called by every path that hands features to the predictor (``get_features`` and
+        the predict-from-reader task) so both agree on the on-device representation.
+        """
         if self._device_format == "jax":
             (features,) = to_device_arrays(features)
         return features

@@ -433,13 +433,21 @@ class Model(TrackedInstance):
             raise ValueError(
                 f"Scheduled job {name} must have a unique name. Existing: {self.prediction_schedule_names}"
             )
-        model_object_input = self.resolve_model_artifact(
+        from unionml_tpu.backend import wire_encode_value
+
+        resolved = self.resolve_model_artifact(
             model_object=model_object,
             model_version=model_version,
             app_version=app_version,
             model_file=model_file,
             loader_kwargs=loader_kwargs,
-        ).model_object
+        )
+        # an explicit in-memory model_object carries no hyperparameters; fall back to
+        # the current artifact's so non-picklable objects can be rebuilt when firing
+        hp = resolved.hyperparameters
+        if hp is None and self._artifact is not None and resolved.model_object is self._artifact.model_object:
+            hp = self._artifact.hyperparameters
+        model_object_input = wire_encode_value(resolved.model_object, hp)
         schedule = Schedule(
             type=ScheduleType.predictor,
             name=name,
@@ -549,6 +557,7 @@ class Model(TrackedInstance):
             model_object = kwargs["model_object"]
             parsed = self._dataset._parser(kwargs[data_arg_name], **self._dataset.parser_kwargs)
             features = self._dataset._feature_transformer(parsed[self._dataset._parser_feature_key])
+            features = self._dataset.finalize_features(features)
             predictions = self._predictor(model_object, features)
             self._run_predict_callbacks(model_object, features, predictions)
             return predictions
@@ -945,8 +954,12 @@ class Model(TrackedInstance):
         backend = self._require_backend()
         from unionml_tpu import remote
 
+        from unionml_tpu.backend import wire_encode_value
+
         model_artifact = remote.get_model_artifact(self, app_version=app_version, model_version=model_version)
-        inputs: Dict[str, Any] = {"model_object": model_artifact.model_object}
+        inputs: Dict[str, Any] = {
+            "model_object": wire_encode_value(model_artifact.model_object, model_artifact.hyperparameters)
+        }
         if features is None:
             workflow_name = self.predict_workflow_name
             inputs.update(reader_kwargs)
@@ -971,8 +984,11 @@ class Model(TrackedInstance):
         if not execution.is_done:
             logger.info("Waiting for execution %s to complete...", execution.id)
             execution = backend.wait(execution)
+        from unionml_tpu.backend import wire_decode_value
+
         outputs = execution.outputs
-        return ModelArtifact(outputs["model_object"], outputs.get("hyperparameters"), outputs.get("metrics"))
+        model_object = wire_decode_value(outputs["model_object"], self)
+        return ModelArtifact(model_object, outputs.get("hyperparameters"), outputs.get("metrics"))
 
     def remote_load(self, execution) -> None:
         """Set ``self.artifact`` from a completed training execution (``model.py:1263-1270``)."""

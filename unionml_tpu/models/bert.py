@@ -148,12 +148,18 @@ class BertModel(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         # the flash kernel consumes per-batch valid lengths, which is exact only for
-        # contiguous right-padding (the HF default); the XLA impl gets the full dense
-        # mask so left-padded / arbitrary masks stay correct there
+        # contiguous right-padding (the HF default); whenever the XLA impl is what
+        # actually runs (explicitly or via "auto" off-TPU) it gets the full dense mask
+        # so left-padded / arbitrary masks stay exact
         kv_lens = None
         dense_mask = None
         if attention_mask is not None:
-            if cfg.attention_impl == "xla":
+            resolved_impl = cfg.attention_impl
+            if resolved_impl == "auto":
+                from unionml_tpu.ops.attention import on_tpu
+
+                resolved_impl = "pallas" if on_tpu() else "xla"
+            if resolved_impl == "xla":
                 dense_mask = attention_mask[:, None, None, :].astype(bool)
             else:
                 kv_lens = jnp.sum(attention_mask.astype(jnp.int32), axis=-1)

@@ -108,7 +108,7 @@ def _flash_kernel(
     # bound the scan: skip fully-masked KV blocks (padding tail; causal upper triangle)
     last_block = jnp.minimum(num_k_blocks, pl.cdiv(kv_len, block_k))
     if causal:
-        last_block = jnp.minimum(last_block, (q_index + 1) * block_q // block_k + 1)
+        last_block = jnp.minimum(last_block, pl.cdiv((q_index + 1) * block_q, block_k))
     acc, row_max, row_sum = jax.lax.fori_loop(0, last_block, body, (acc, row_max, row_sum))
     o_ref[0] = (acc / jnp.maximum(row_sum, 1e-30)).astype(o_ref.dtype)
 
@@ -216,7 +216,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _on_tpu() -> bool:
+def on_tpu() -> bool:
     """True only for genuine TPU devices (incl. remote-TPU plugin backends)."""
     if jax.default_backend() == "tpu":
         return True
@@ -243,7 +243,7 @@ def attention(
     elsewhere.
     """
     if impl == "auto":
-        impl = "pallas" if (_on_tpu() and mask is None) else "xla"
+        impl = "pallas" if (on_tpu() and mask is None) else "xla"
     if impl == "pallas":
         if mask is not None:
             raise ValueError(

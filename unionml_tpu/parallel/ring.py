@@ -1,0 +1,126 @@
+"""Ring attention: sequence/context parallelism over the mesh's sequence axis.
+
+Long-context story (SURVEY.md §5 flags this as a designed extension point; here it is
+implemented): Q/K/V arrive sequence-sharded over the ``"sequence"`` mesh axis; each
+device keeps its Q shard resident and the K/V shards rotate around the ring via
+``lax.ppermute`` (ICI neighbor exchange), one hop per step, while a flash-style online
+softmax folds each visiting block into the local accumulator. Peak memory per device is
+O(seq/N) and the N-1 permutes overlap naturally with the per-block matmuls under XLA's
+scheduler — no materialized (seq x seq) score matrix anywhere.
+
+Built with ``shard_map`` so it composes with the data/tensor axes of the same mesh
+(batch stays sharded over "data", heads may be sharded over "tensor").
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from unionml_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+
+_NEG_INF = -1e30
+
+
+def _local_block_attention(q, k_blk, v_blk, acc, row_max, row_sum, q_offset, k_offset, causal, sm_scale):
+    """Fold one visiting K/V block into the online-softmax accumulator.
+
+    q: (b, h, Lq, d); k_blk/v_blk: (b, h, Lk, d); accumulators broadcast alike.
+    Offsets are the global sequence positions of the local shards (for causal masks).
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+        k_pos = k_offset + lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+        scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+
+    block_max = jnp.max(scores, axis=-1, keepdims=True)
+    new_max = jnp.maximum(row_max, block_max)
+    correction = jnp.exp(row_max - new_max)
+    probs = jnp.exp(scores - new_max)
+    acc = acc * correction + jnp.einsum(
+        "bhqk,bhkd->bhqd", probs, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+    row_sum = row_sum * correction + jnp.sum(probs, axis=-1, keepdims=True)
+    return acc, new_max, row_sum
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
+    """Per-device body: rotate K/V around the ring, folding blocks as they arrive."""
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    local_len = q.shape[-2]
+    q32 = q.astype(jnp.float32)
+
+    acc = jnp.zeros(q.shape[:-2] + (local_len, v.shape[-1]), dtype=jnp.float32)
+    row_max = jnp.full(q.shape[:-2] + (local_len, 1), _NEG_INF, dtype=jnp.float32)
+    row_sum = jnp.zeros(q.shape[:-2] + (local_len, 1), dtype=jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step_fn(carry, step):
+        acc, row_max, row_sum, k_blk, v_blk = carry
+        src_index = (my_index - step) % axis_size  # whose K/V block we hold this step
+        acc, row_max, row_sum = _local_block_attention(
+            q32,
+            k_blk,
+            v_blk,
+            acc,
+            row_max,
+            row_sum,
+            q_offset=my_index * local_len,
+            k_offset=src_index * local_len,
+            causal=causal,
+            sm_scale=sm_scale,
+        )
+        # hand our current block to the right neighbor (ICI neighbor exchange)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (acc, row_max, row_sum, k_blk, v_blk), None
+
+    (acc, row_max, row_sum, _, _), _ = lax.scan(
+        step_fn, (acc, row_max, row_sum, k, v), jnp.arange(axis_size)
+    )
+    return (acc / jnp.maximum(row_sum, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    seq_axis: str = SEQUENCE_AXIS,
+    batch_axis: str = DATA_AXIS,
+) -> jax.Array:
+    """Sequence-parallel attention over ``mesh``'s ``seq_axis``.
+
+    Inputs are (batch, heads, seq, head_dim); ``seq`` must divide the sequence-axis
+    size. Batch is sharded over ``batch_axis`` when present. The result carries the
+    same sharding as ``q``.
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    batch = batch_axis if batch_axis in mesh.axis_names else None
+    spec = P(batch, None, seq_axis, None)
+
+    body = functools.partial(_ring_attention_local, axis_name=seq_axis, causal=causal, sm_scale=scale)
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return mapped(q, k, v)
+
+
+def sequence_sharding(mesh: Mesh, batch_axis: str = DATA_AXIS, seq_axis: str = SEQUENCE_AXIS) -> NamedSharding:
+    """Sharding for (batch, heads, seq, head_dim) activations in the ring layout."""
+    batch = batch_axis if batch_axis in mesh.axis_names else None
+    return NamedSharding(mesh, P(batch, None, seq_axis, None))

@@ -126,6 +126,7 @@ def get_model_artifact(
     model_version: Optional[str] = None,
 ) -> "ModelArtifact":
     """Fetch a trained model artifact from backend lineage (``remote.py:272-280``)."""
+    from unionml_tpu.backend import wire_decode_value
     from unionml_tpu.model import ModelArtifact
 
     execution = get_model_execution(model, app_version=app_version, model_version=model_version)
@@ -133,7 +134,8 @@ def get_model_artifact(
         outputs = execution.outputs
     except BackendError as exc:
         raise ModelArtifactNotFound(str(exc)) from exc
-    return ModelArtifact(outputs["model_object"], outputs.get("hyperparameters"), outputs.get("metrics"))
+    model_object = wire_decode_value(outputs["model_object"], model)
+    return ModelArtifact(model_object, outputs.get("hyperparameters"), outputs.get("metrics"))
 
 
 def list_model_versions(model: "Model", app_version: Optional[str] = None, limit: int = 10) -> List[str]:

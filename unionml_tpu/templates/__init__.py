@@ -1,0 +1,70 @@
+"""Project templates + a minimal renderer (cookiecutter replacement).
+
+Reference parity: the five cookiecutter scaffolds under ``unionml/templates/`` with
+shared pre/post hooks (name validation; git init of the generated app —
+``templates/common/hooks/pre_gen_project.py:4-12``, ``post_gen_project.py:7-9``).
+Rendering is plain ``{{app_name}}`` substitution in paths and contents.
+"""
+
+import subprocess
+from pathlib import Path
+from typing import List
+
+TEMPLATES_ROOT = Path(__file__).parent
+
+_DESCRIPTIONS = {
+    "basic": "sklearn digits classifier + HTTP serving (the README quickstart)",
+    "jax-digits": "jax-native digits MLP with a jit-compiled trainer",
+    "mnist-cnn": "CNN image classifier trained with the compiled fit() loop",
+    "bert-finetune": "BERT-base text classification fine-tune with checkpointing",
+    "data-parallel": "data-parallel training over a TPU mesh (v5e-8 layout)",
+}
+
+
+def list_templates() -> List[str]:
+    return sorted(
+        d.name for d in TEMPLATES_ROOT.iterdir() if d.is_dir() and not d.name.startswith("_")
+    )
+
+
+def template_description(name: str) -> str:
+    return _DESCRIPTIONS.get(name, "")
+
+
+def _validate_app_name(app_name: str) -> None:
+    """Pre-generation guard: the app name must be an importable module name."""
+    if not app_name.replace("_", "a").isalnum() or not app_name[0].isalpha():
+        raise ValueError(
+            f"app name {app_name!r} must be a valid Python identifier (letters, digits, underscores)"
+        )
+
+
+def render_template(name: str, app_name: str, destination: Path) -> Path:
+    """Render a template into ``destination/app_name`` and git-init it.
+
+    The git init matters: app versions are git shas (``unionml_tpu.remote.get_app_version``).
+    """
+    _validate_app_name(app_name)
+    source = TEMPLATES_ROOT / name
+    if not source.is_dir():
+        raise ValueError(f"Unknown template {name!r}; available: {list_templates()}")
+    target_root = destination / app_name
+    if target_root.exists():
+        raise FileExistsError(f"{target_root} already exists")
+
+    for path in sorted(source.rglob("*")):
+        rel = path.relative_to(source)
+        rendered_rel = Path(str(rel).replace("{{app_name}}", app_name))
+        target = target_root / rendered_rel
+        if path.is_dir():
+            target.mkdir(parents=True, exist_ok=True)
+        else:
+            target.parent.mkdir(parents=True, exist_ok=True)
+            target.write_text(path.read_text().replace("{{app_name}}", app_name))
+
+    try:
+        subprocess.run(["git", "init", "-q"], cwd=target_root, check=True)
+        subprocess.run(["git", "add", "-A"], cwd=target_root, check=True)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        pass  # git unavailable: versioning falls back to explicit app_version
+    return target_root

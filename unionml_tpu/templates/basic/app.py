@@ -1,0 +1,42 @@
+"""{{app_name}}: sklearn digits classifier on unionml-tpu (the quickstart)."""
+
+from typing import List
+
+import pandas as pd
+from sklearn.datasets import load_digits
+from sklearn.linear_model import LogisticRegression
+
+from unionml_tpu import Dataset, Model
+
+dataset = Dataset(name="{{app_name}}_dataset", test_size=0.2, shuffle=True, targets=["target"])
+model = Model(name="{{app_name}}", init=LogisticRegression, dataset=dataset)
+
+
+@dataset.reader
+def reader() -> pd.DataFrame:
+    return load_digits(as_frame=True).frame
+
+
+@model.trainer
+def trainer(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
+    return estimator.fit(features, target.squeeze())
+
+
+@model.predictor
+def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> List[float]:
+    return [float(x) for x in estimator.predict(features)]
+
+
+@model.evaluator
+def evaluator(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
+    from sklearn.metrics import accuracy_score
+
+    return float(accuracy_score(target.squeeze(), estimator.predict(features)))
+
+
+if __name__ == "__main__":
+    model_object, metrics = model.train(hyperparameters={"C": 1.0, "max_iter": 5000})
+    print(f"metrics: {metrics}")
+    model.save("model.joblib")
+    features = load_digits(as_frame=True).frame.sample(5, random_state=42).drop(columns=["target"])
+    print(f"predictions: {model.predict(features=features.to_dict(orient='records'))}")

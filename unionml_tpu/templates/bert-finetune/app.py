@@ -1,0 +1,106 @@
+"""{{app_name}}: BERT-base text-classification fine-tune (the flagship config).
+
+Data contract: the reader returns a dict of arrays (input_ids, attention_mask,
+labels) — plug in your tokenizer of choice upstream. Training runs the compiled
+fit() loop with step-level checkpointing; on a v5e-8 pass a mesh for data
+parallelism (see the data-parallel template).
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.models import (
+    BertConfig,
+    BertForSequenceClassification,
+    TrainState,
+    create_train_state,
+    fit,
+    init_params,
+    make_classifier_eval_step,
+)
+
+SEQ_LEN = 128
+
+dataset = Dataset(name="{{app_name}}_dataset", test_size=0.1, targets=["labels"])
+
+config = BertConfig.base(num_labels=2, dtype=jnp.bfloat16)
+bert = BertForSequenceClassification(config)
+
+
+def init(learning_rate: float = 2e-5, warmup_steps: int = 100) -> TrainState:
+    variables = init_params(config, seq_len=SEQ_LEN)  # or import_hf_weights(...)
+    return create_train_state(
+        bert, variables, learning_rate=learning_rate, warmup_steps=warmup_steps, total_steps=10_000
+    )
+
+
+model = Model(name="{{app_name}}", init=init, dataset=dataset)
+
+
+@dataset.reader
+def reader(n: int = 1024, seed: int = 0) -> Dict[str, np.ndarray]:
+    """Replace with your tokenized dataset; shapes: (n, SEQ_LEN) int32 + (n,) labels."""
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": rng.integers(0, config.vocab_size, size=(n, SEQ_LEN)).astype(np.int32),
+        "attention_mask": np.ones((n, SEQ_LEN), dtype=np.int32),
+        "labels": rng.integers(0, config.num_labels, size=(n,)).astype(np.int32),
+    }
+
+
+@model.trainer
+def trainer(
+    state: TrainState,
+    features: Dict[str, np.ndarray],
+    targets: Dict[str, np.ndarray],
+    *,
+    num_epochs: int = 3,
+    batch_size: int = 32,
+    checkpoint_dir: str = "checkpoints",
+) -> TrainState:
+    data = {**features, **targets}
+    result = fit(
+        state,
+        data,
+        batch_size=batch_size,
+        num_epochs=num_epochs,
+        input_signature=("input_ids", "attention_mask"),
+        checkpoint_dir=checkpoint_dir,
+        log_every=50,
+    )
+    print(f"throughput: {result.examples_per_s:.1f} examples/s")
+    return result.state
+
+
+@model.predictor
+def predictor(state: TrainState, features: Dict[str, np.ndarray]) -> jax.Array:
+    logits = state.apply_fn(
+        {"params": state.params},
+        jnp.asarray(features["input_ids"]),
+        jnp.asarray(features["attention_mask"]),
+        deterministic=True,
+    )
+    return jnp.argmax(logits, axis=-1)
+
+
+@model.evaluator
+def evaluator(state: TrainState, features: Dict[str, np.ndarray], targets: Dict[str, np.ndarray]) -> float:
+    metrics = make_classifier_eval_step(input_signature=("input_ids", "attention_mask"))(
+        state,
+        {
+            "input_ids": jnp.asarray(features["input_ids"]),
+            "attention_mask": jnp.asarray(features["attention_mask"]),
+            "labels": jnp.asarray(targets["labels"]),
+        },
+    )
+    return float(metrics["accuracy"])
+
+
+if __name__ == "__main__":
+    state, metrics = model.train(trainer_kwargs={"num_epochs": 1, "batch_size": 32})
+    print(f"metrics: {metrics}")
+    model.save("bert_model.ckpt")

@@ -1,0 +1,54 @@
+"""{{app_name}}: jax-native digits MLP — the trainer is a compiled fit() loop."""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+from sklearn.datasets import load_digits
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.models import MLPClassifier, TrainState, create_train_state, fit, make_classifier_eval_step
+
+dataset = Dataset(name="{{app_name}}_dataset", test_size=0.2, targets=["target"], device_format="jax")
+
+mlp = MLPClassifier(hidden_sizes=(128,), num_classes=10)
+
+
+def init(learning_rate: float = 1e-3) -> TrainState:
+    params = mlp.init(jax.random.PRNGKey(0), jnp.zeros((1, 64)))
+    return create_train_state(mlp, params, learning_rate=learning_rate)
+
+
+model = Model(name="{{app_name}}", init=init, dataset=dataset)
+
+
+@dataset.reader
+def reader() -> pd.DataFrame:
+    return load_digits(as_frame=True).frame
+
+
+@model.trainer
+def trainer(state: TrainState, features: jax.Array, target: jax.Array, *, num_epochs: int = 30) -> TrainState:
+    data = {"inputs": np.asarray(features), "labels": np.asarray(target).reshape(-1).astype(np.int32)}
+    return fit(state, data, batch_size=512, num_epochs=num_epochs, log_every=10_000).state
+
+
+@model.predictor
+def predictor(state: TrainState, features: jax.Array) -> jax.Array:
+    return jnp.argmax(state.apply_fn({"params": state.params}, features), axis=-1).astype(jnp.float32)
+
+
+@model.evaluator
+def evaluator(state: TrainState, features: jax.Array, target: jax.Array) -> float:
+    metrics = make_classifier_eval_step()(
+        state, {"inputs": features, "labels": jnp.asarray(np.asarray(target).reshape(-1), dtype=jnp.int32)}
+    )
+    return float(metrics["accuracy"])
+
+
+if __name__ == "__main__":
+    state, metrics = model.train(hyperparameters={"learning_rate": 1e-3})
+    print(f"metrics: {metrics}")
+    model.save("model.ckpt")

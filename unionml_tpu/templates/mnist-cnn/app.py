@@ -1,0 +1,67 @@
+"""{{app_name}}: CNN image classifier (the Keras-MNIST tutorial shape, compiled).
+
+The reader returns a dict of arrays: images (n, 28, 28) float32 and labels (n,).
+Swap the synthetic reader for your MNIST loader of choice.
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from unionml_tpu import Dataset, Model
+from unionml_tpu.models import CNNClassifier, TrainState, create_train_state, fit, make_classifier_eval_step
+
+dataset = Dataset(name="{{app_name}}_dataset", test_size=0.2, targets=["labels"])
+
+cnn = CNNClassifier(num_classes=10)
+
+
+def init(learning_rate: float = 3e-4) -> TrainState:
+    params = cnn.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    return create_train_state(cnn, params, learning_rate=learning_rate)
+
+
+model = Model(name="{{app_name}}", init=init, dataset=dataset)
+
+
+@dataset.reader
+def reader(n: int = 2048, seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n)
+    images = rng.normal(size=(n, 28, 28)).astype(np.float32) + labels[:, None, None] * 0.1
+    return {"images": images, "labels": labels.astype(np.int32)}
+
+
+@model.trainer
+def trainer(
+    state: TrainState,
+    features: Dict[str, np.ndarray],
+    targets: Dict[str, np.ndarray],
+    *,
+    num_epochs: int = 10,
+    batch_size: int = 512,
+) -> TrainState:
+    data = {"inputs": features["images"], "labels": targets["labels"]}
+    return fit(state, data, batch_size=batch_size, num_epochs=num_epochs, log_every=100).state
+
+
+@model.predictor
+def predictor(state: TrainState, features: Dict[str, np.ndarray]) -> jax.Array:
+    logits = state.apply_fn({"params": state.params}, jnp.asarray(features["images"]))
+    return jnp.argmax(logits, axis=-1)
+
+
+@model.evaluator
+def evaluator(state: TrainState, features: Dict[str, np.ndarray], targets: Dict[str, np.ndarray]) -> float:
+    metrics = make_classifier_eval_step()(
+        state, {"inputs": jnp.asarray(features["images"]), "labels": jnp.asarray(targets["labels"])}
+    )
+    return float(metrics["accuracy"])
+
+
+if __name__ == "__main__":
+    state, metrics = model.train(hyperparameters={"learning_rate": 3e-4})
+    print(f"metrics: {metrics}")
+    model.save("cnn_model.ckpt")
