@@ -1,0 +1,137 @@
+"""Serving integration: boot the real HTTP server as a subprocess and drive it.
+
+Reference parity: ``tests/integration/test_fastapi.py`` — train a real model via the
+app module, launch ``serve`` as a subprocess, assert ``/health`` and ``/predict`` over
+actual HTTP, and the missing-model error path.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for_health(port: int, timeout: float = 30.0) -> dict:
+    deadline = time.monotonic() + timeout
+    last_error = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=2) as resp:
+                return json.loads(resp.read())
+        except Exception as exc:  # noqa: BLE001
+            last_error = exc
+            time.sleep(0.3)
+    raise TimeoutError(f"server did not become healthy: {last_error}")
+
+
+def _post_predict(port: int, payload: dict):
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(request, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def served_model(tmp_path):
+    """Train the backend app locally, save it, and serve it in a subprocess."""
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO_ROOT),
+        "JAX_PLATFORMS": "cpu",
+    }
+    model_path = tmp_path / "model.joblib"
+    train_script = (
+        "from tests.integration.backend_app import model\n"
+        "model.train(hyperparameters={'max_iter': 200}, n=80)\n"
+        f"model.save({str(model_path)!r})\n"
+    )
+    subprocess.run([sys.executable, "-c", train_script], env=env, cwd=REPO_ROOT, check=True)
+
+    port = _free_port()
+    server = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "unionml_tpu.cli",
+            "serve",
+            "tests.integration.backend_app:model",
+            "--model-path",
+            str(model_path),
+            "--port",
+            str(port),
+        ],
+        env=env,
+        cwd=REPO_ROOT,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        yield port, server
+    finally:
+        server.terminate()
+        server.wait(timeout=10)
+
+
+def test_serving_subprocess_health_and_predict(served_model):
+    port, _ = served_model
+    health = _wait_for_health(port)
+    assert health == {"message": "OK", "status": 200}
+
+    predictions = _post_predict(port, {"features": [{"x1": 2.0, "x2": 2.0}, {"x1": -3.0, "x2": -3.0}]})
+    assert predictions == [1.0, 0.0]
+
+    # reader-input path: the server runs the full reader -> predict pipeline
+    predictions = _post_predict(port, {"inputs": {"n": 7}})
+    assert len(predictions) == 7
+
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _post_predict(port, {})
+    assert excinfo.value.code == 500
+
+
+def test_serving_missing_model_path_fails_loudly(tmp_path):
+    """Reference parity: serve without a model path errors on startup (``test_fastapi.py:126-131``)."""
+    env = {**os.environ, "PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu"}
+    env.pop("UNIONML_MODEL_PATH", None)
+    port = _free_port()
+    server = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "unionml_tpu.cli",
+            "serve",
+            "tests.integration.backend_app:model",
+            "--port",
+            str(port),
+        ],
+        env=env,
+        cwd=REPO_ROOT,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        output, _ = server.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        server.kill()
+        raise
+    assert server.returncode != 0
+    assert "Model artifact path not specified" in output

@@ -1,0 +1,28 @@
+"""Profiling module tests: trace capture, stage timings, memory stats."""
+
+import jax.numpy as jnp
+
+from unionml_tpu.profiling import annotate, device_memory_stats, workflow_timings, xprof_trace
+
+from tests.unit.model_fixtures import make_sklearn_model
+
+
+def test_xprof_trace_writes_files(tmp_path):
+    with xprof_trace(str(tmp_path / "trace")):
+        with annotate("matmul"):
+            jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    files = [p for p in (tmp_path / "trace").rglob("*") if p.is_file()]
+    assert files, "profiler trace must produce output files"
+
+
+def test_workflow_timings_after_train():
+    model = make_sklearn_model()
+    model.train(hyperparameters={"C": 1.0, "max_iter": 200})
+    timings = workflow_timings(model.train_workflow())
+    assert set(timings) == {"test_dataset.dataset_task", "test_model.train_task"}
+    assert all(t is not None and t >= 0 for t in timings.values())
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats()
+    assert stats and {"device", "bytes_in_use", "bytes_limit"} <= set(stats[0])

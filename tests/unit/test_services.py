@@ -1,0 +1,85 @@
+"""Service adapter tests: serverless event handler (and bentoml when installed)."""
+
+import json
+
+import pytest
+
+from unionml_tpu.services import make_event_handler
+from unionml_tpu.utils import module_is_installed
+
+from tests.unit.model_fixtures import make_sklearn_model
+
+
+@pytest.fixture()
+def handler_and_model(tmp_path, monkeypatch):
+    model = make_sklearn_model()
+    model.train(hyperparameters={"C": 1.0, "max_iter": 300})
+    path = tmp_path / "model.joblib"
+    model.save(path)
+    model._artifact = None
+    monkeypatch.setenv("UNIONML_MODEL_PATH", str(path))
+    return make_event_handler(model), model
+
+
+def test_api_gateway_features_event(handler_and_model):
+    handler, _ = handler_and_model
+    event = {"body": json.dumps({"features": [{"x1": 1.0, "x2": 1.0}]})}
+    response = handler(event, None)
+    assert response["statusCode"] == 200
+    predictions = json.loads(response["body"])
+    assert len(predictions) == 1 and predictions[0] in (0.0, 1.0)
+
+
+def test_api_gateway_inputs_event(handler_and_model):
+    handler, _ = handler_and_model
+    event = {"body": json.dumps({"inputs": {"sample_frac": 0.1, "random_state": 3}})}
+    response = handler(event, None)
+    assert response["statusCode"] == 200
+    assert len(json.loads(response["body"])) == 10
+
+
+def test_empty_body_event(handler_and_model):
+    handler, _ = handler_and_model
+    response = handler({"body": json.dumps({})}, None)
+    assert response["statusCode"] == 500
+    assert "must be supplied" in response["body"]
+
+
+def test_storage_event_routes_through_feature_loader(handler_and_model, tmp_path, monkeypatch):
+    handler_default, model = handler_and_model
+    features_file = tmp_path / "bucket" / "features.json"
+    features_file.parent.mkdir(parents=True)
+    features_file.write_text(json.dumps([{"x1": 0.5, "x2": 0.5}]))
+
+    handler = make_event_handler(model, path_resolver=lambda p: tmp_path / p)
+    event = {"Records": [{"s3": {"bucket": {"name": "bucket"}, "object": {"key": "features.json"}}}]}
+    response = handler(event, None)
+    assert response["statusCode"] == 200
+    results = json.loads(response["body"])
+    assert list(results) == ["bucket/features.json"]
+
+
+def test_unrecognized_event(handler_and_model):
+    handler, _ = handler_and_model
+    assert handler({"something": 1}, None)["statusCode"] == 400
+
+
+def test_model_load_failure(monkeypatch):
+    model = make_sklearn_model()
+    monkeypatch.delenv("UNIONML_MODEL_PATH", raising=False)
+    handler = make_event_handler(model)
+    response = handler({"body": json.dumps({"features": []})}, None)
+    assert response["statusCode"] == 500
+    assert "Model load failed" in response["body"]
+
+
+@pytest.mark.skipif(not module_is_installed("bentoml"), reason="bentoml not installed")
+def test_bentoml_service_construction():
+    from unionml_tpu.services import BentoMLService
+
+    model = make_sklearn_model()
+    model.train(hyperparameters={"C": 1.0, "max_iter": 300})
+    service = BentoMLService(model)
+    tag = service.save_model()
+    svc = service.configure(str(tag.tag))
+    assert svc is not None

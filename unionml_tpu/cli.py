@@ -115,11 +115,29 @@ def deactivate_schedules(app: str, app_version: Optional[str], schedule_names) -
 @click.option("--app-version", "-v", default=None)
 @click.option("--local", is_flag=True, help="Train locally in-process instead of on the backend.")
 @click.option("--wait", "-w", is_flag=True, help="Wait for the remote execution to complete.")
-def train(app: str, inputs: Optional[str], app_version: Optional[str], local: bool, wait: bool) -> None:
+@click.option("--profile-dir", default=None, help="Capture an xprof trace + stage timings into this directory (local mode).")
+def train(
+    app: str,
+    inputs: Optional[str],
+    app_version: Optional[str],
+    local: bool,
+    wait: bool,
+    profile_dir: Optional[str],
+) -> None:
     """Run a training job (remote by default, local with --local)."""
     model = _load_model(app)
     parsed = _parse_json_opt(inputs, "--inputs")
     if local:
+        if profile_dir:
+            import contextlib
+
+            from unionml_tpu.profiling import workflow_timings, xprof_trace
+
+            with xprof_trace(profile_dir):
+                _, metrics = model.train(**parsed)
+            timings = workflow_timings(model.train_workflow())
+            click.echo(json.dumps({"metrics": metrics, "stage_timings_s": timings}, default=str))
+            return
         _, metrics = model.train(**parsed)
         click.echo(json.dumps({"metrics": metrics}, default=str))
         return

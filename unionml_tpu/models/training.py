@@ -168,9 +168,36 @@ def fit(
     checkpoint_every: int = 100,
     log_every: int = 50,
     seed: int = 0,
+    prefetch: bool = False,
 ) -> FitResult:
-    """Run the compiled train loop; resumes from ``checkpoint_dir`` when present."""
+    """Run the compiled train loop; resumes from ``checkpoint_dir`` when present.
+
+    ``prefetch=True`` gathers batches with the native threaded prefetcher
+    (:class:`unionml_tpu.native.PrefetchLoader`), overlapping host-side batch assembly
+    with device compute; falls back to Python batching when the native build is
+    unavailable.
+    """
     step_fn = make_classifier_train_step(mesh=mesh, param_spec=param_spec, input_signature=input_signature)
+
+    prefetch_loader = None
+    if prefetch:
+        from unionml_tpu.native import PrefetchLoader
+
+        prefetch_loader = PrefetchLoader(data, batch_size)
+
+    def batch_iterator(epoch_rng):
+        if prefetch_loader is not None:
+            sharding = batch_sharding(mesh) if mesh is not None else None
+            # copy=True (the default) hands over loader-independent arrays, which is
+            # required here: device transfers are async and would otherwise race the
+            # slot ring recycling
+            for views in prefetch_loader.epoch(rng=epoch_rng):
+                if sharding is not None:
+                    yield {k: jax.device_put(v, sharding) for k, v in views.items()}
+                else:
+                    yield views
+            return
+        yield from dict_batches(data, batch_size, rng=epoch_rng, mesh=mesh)
 
     checkpointer = None
     if checkpoint_dir is not None:
@@ -188,7 +215,7 @@ def fit(
     step = int(state.step)
     start_step = step
     # compile outside the timed region so wall-clock measures steady-state steps
-    first_batch = next(iter(dict_batches(data, batch_size, rng=rng, mesh=mesh)))
+    first_batch = next(iter(batch_iterator(rng)))
     state, metrics = step_fn(state, first_batch)
     jax.block_until_ready(metrics["loss"])
     step += 1
@@ -198,7 +225,7 @@ def fit(
     # an explicit step budget overrides the epoch count (loops data as needed)
     epochs = num_epochs if num_steps is None else max(num_epochs, 10**9)
     for epoch in range(epochs):
-        for batch in dict_batches(data, batch_size, rng=rng, mesh=mesh):
+        for batch in batch_iterator(rng):
             state, metrics = step_fn(state, batch)
             step += 1
             if step % log_every == 0:
@@ -216,6 +243,8 @@ def fit(
     wall = time.perf_counter() - t0
     if checkpointer is not None:
         checkpointer.flush()
+    if prefetch_loader is not None:
+        prefetch_loader.close()
 
     executed = step - start_step - 1  # first (compile) step excluded from the timing
     result = FitResult(

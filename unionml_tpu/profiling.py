@@ -1,0 +1,76 @@
+"""Tracing & profiling: per-stage timing plus jax/XLA profiler capture.
+
+Reference state: none — observability is delegated to the Flyte console (SURVEY.md §5).
+Here the framework owns it: every :class:`~unionml_tpu.stage.Stage` records its last
+wall-clock duration (surfaced via :func:`workflow_timings` and the CLI's
+``train --profile-dir``), and this module adds xprof trace capture around any block
+(viewable with TensorBoard/xprof) plus device-memory statistics.
+"""
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from unionml_tpu._logging import logger
+
+
+@contextlib.contextmanager
+def xprof_trace(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a jax profiler trace (XLA ops, TPU activity) into ``log_dir``."""
+    import jax
+
+    logger.info("Starting profiler trace -> %s", log_dir)
+    with jax.profiler.trace(log_dir, create_perfetto_link=False):
+        yield
+    logger.info("Profiler trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name a region in profiler traces (shows up in xprof timelines)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StageTimings:
+    """Collects per-stage wall-clock timings across a workflow execution."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+
+    def record(self, stage_name: str, duration_s: float) -> None:
+        self.records.append({"stage": stage_name, "duration_s": duration_s, "at": time.time()})
+
+    def summary(self) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        for rec in self.records:
+            totals[rec["stage"]] = totals.get(rec["stage"], 0.0) + rec["duration_s"]
+        return totals
+
+
+def workflow_timings(workflow: Any) -> Dict[str, Optional[float]]:
+    """Last-run durations of every stage in a workflow (None = not yet run)."""
+    return {node.stage.name: node.stage.last_duration for node in workflow.nodes}
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-device memory statistics (bytes in use / limit) where the backend reports them."""
+    import jax
+
+    stats = []
+    for device in jax.devices():
+        try:
+            raw = device.memory_stats() or {}
+        except Exception:  # pragma: no cover - backend without memory_stats
+            raw = {}
+        stats.append(
+            {
+                "device": str(device),
+                "bytes_in_use": raw.get("bytes_in_use"),
+                "bytes_limit": raw.get("bytes_limit"),
+                "peak_bytes_in_use": raw.get("peak_bytes_in_use"),
+            }
+        )
+    return stats

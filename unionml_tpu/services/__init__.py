@@ -1,0 +1,14 @@
+"""Optional serving adapters: BentoML-style packaging and serverless event handlers.
+
+Reference parity: ``unionml/services/__init__.py:4-6`` conditionally exposes the
+bentoml integration; the serverless handler replaces the reference's Mangum/AWS-Lambda
+*pattern* (shipped only via templates/tests there) with a first-class adapter.
+"""
+
+from unionml_tpu.services.event_handler import make_event_handler
+from unionml_tpu.utils import module_is_installed
+
+if module_is_installed("bentoml"):
+    from unionml_tpu.services.bentoml_service import BentoMLService  # noqa: F401
+
+__all__ = ["make_event_handler"]

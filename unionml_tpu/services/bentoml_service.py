@@ -1,0 +1,108 @@
+"""BentoML adapter: package a unionml-tpu model as a bentoml service.
+
+Reference parity: ``unionml/services/bentoml.py:31-247`` — a wrapper binding a Model to
+bentoml's model store, runner, and service machinery, with IO descriptors inferred from
+the dataset's feature type and the predictor's return type.
+
+TPU-native delta: the runnable advertises TPU support (``SUPPORTED_RESOURCES`` includes
+``"google.com/tpu"``; the reference's runnable lists ``"nvidia.com/gpu"`` at
+``services/bentoml.py:202``), and the runnable holds a
+:class:`~unionml_tpu.serving.resident.ResidentPredictor` so batch inference runs the
+compiled executable. Importable only when ``bentoml`` is installed.
+"""
+
+from typing import Any, Callable, List, Optional
+
+import bentoml
+
+from unionml_tpu._logging import logger
+from unionml_tpu.serving.resident import ResidentPredictor
+
+
+class BentoMLService:
+    """Binds a unionml-tpu Model to bentoml save/load/serve."""
+
+    def __init__(self, model: Any, framework: str = "picklable_model"):
+        self._model = model
+        self._framework = framework
+        self._svc: Optional["bentoml.Service"] = None
+        self._runner = None
+
+    @property
+    def model(self) -> Any:
+        return self._model
+
+    @property
+    def svc(self) -> "bentoml.Service":
+        if self._svc is None:
+            raise RuntimeError("Call BentoMLService.configure(...) first.")
+        return self._svc
+
+    def save_model(self, name: Optional[str] = None, **save_kwargs) -> Any:
+        """Store the trained model object in the bentoml model store."""
+        if self._model.artifact is None:
+            raise RuntimeError("Train or load a model before saving it to the bento store.")
+        name = name or self._model.name
+        module = getattr(bentoml, self._framework)
+        return module.save_model(name, self._model.artifact.model_object, **save_kwargs)
+
+    def load_model(self, tag: str) -> Any:
+        module = getattr(bentoml, self._framework)
+        return module.load_model(tag)
+
+    def create_runnable(self, tag: str) -> type:
+        """A bentoml Runnable whose resources include TPU (never only-GPU)."""
+        service = self
+
+        class UnionMLTPURunnable(bentoml.Runnable):
+            SUPPORTED_RESOURCES = ("cpu", "google.com/tpu")
+            SUPPORTS_CPU_MULTI_THREADING = True
+
+            def __init__(self):
+                from unionml_tpu.model import ModelArtifact
+
+                model_object = service.load_model(tag)
+                service._model.artifact = ModelArtifact(model_object)
+                self._resident = ResidentPredictor(service._model)
+                self._resident.setup()
+
+            @bentoml.Runnable.method(batchable=False)
+            def predict(self, features: Any) -> Any:
+                return self._resident.predict(features=features)
+
+        return UnionMLTPURunnable
+
+    def configure(
+        self,
+        tag: str,
+        name: Optional[str] = None,
+        enable_async: bool = False,
+        supported_resources: Optional[List[str]] = None,
+    ) -> "bentoml.Service":
+        """Build the runner + service (``services/bentoml.py:72-131`` analogue)."""
+        runnable = self.create_runnable(tag)
+        if supported_resources:
+            runnable.SUPPORTED_RESOURCES = tuple(supported_resources)
+        self._runner = bentoml.Runner(runnable, name=f"{self._model.name}-runner")
+        svc = bentoml.Service(name or self._model.name, runners=[self._runner])
+        handler = self._make_api(enable_async)
+        svc.api(input=bentoml.io.JSON(), output=bentoml.io.JSON())(handler)
+        self._svc = svc
+        return svc
+
+    def _make_api(self, enable_async: bool) -> Callable:
+        runner = self._runner
+
+        # ResidentPredictor.predict runs the dataset's feature pipeline itself —
+        # the raw payload goes straight through to avoid double transformation
+        if enable_async:
+
+            async def predict(payload: Any) -> Any:
+                return await runner.predict.async_run(payload)
+
+            return predict
+
+        def predict(payload: Any) -> Any:
+            return runner.predict.run(payload)
+
+        return predict
