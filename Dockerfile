@@ -1,0 +1,30 @@
+# TPU VM serving/training image for unionml-tpu apps.
+#
+# Reference parity: the reference ships a python-slim Dockerfile copying the app
+# (reference Dockerfile, 27 lines); the TPU-native equivalent installs jax[tpu] so the
+# same image serves as the worker for TPU pod slices and the resident-predictor server.
+#
+# Build from an app directory created by `unionml-tpu init`:
+#   docker build --build-arg APP_DIR=. -t my-unionml-tpu-app .
+
+FROM python:3.12-slim
+
+ARG APP_DIR=.
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ git \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /root
+
+# jax[tpu] pulls libtpu via the Google releases index; CPU fallback works everywhere
+RUN pip install --no-cache-dir "jax[tpu]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir unionml-tpu scikit-learn
+
+COPY ${APP_DIR} /root/app
+WORKDIR /root/app
+
+# serving by default; workers override the command with the backend worker entrypoint
+EXPOSE 8000
+CMD ["unionml-tpu", "serve", "app:model", "--host", "0.0.0.0", "--port", "8000", "--remote"]

@@ -122,3 +122,35 @@ def test_failed_worker_surfaces_error(app_model):
     with pytest.raises(BackendError, match="failed"):
         backend.wait(execution, timeout=60)
     assert execution.error
+
+
+def test_job_level_retry_recovers_transient_failure(tmp_path, monkeypatch):
+    """A worker crash within the retry budget respawns and succeeds (SURVEY.md §5)."""
+    REPO = Path(__file__).resolve().parents[2]
+    monkeypatch.setenv("PYTHONPATH", str(REPO))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("UNIONML_TEST_FLAKY_DIR", str(tmp_path / "flaky"))
+    monkeypatch.chdir(REPO)
+
+    from tests.integration.flaky_app import model
+    from unionml_tpu.backend import LocalBackend
+    from unionml_tpu.exceptions import BackendError
+
+    backend = LocalBackend(root=tmp_path / "backend", retries=2)
+    model.remote(backend)
+    model._artifact = None
+    model.remote_deploy(app_version="v-flaky")
+    artifact = model.remote_train(app_version="v-flaky", hyperparameters={"max_iter": 100}, wait=True)
+    assert artifact.metrics["train"] > 0.5
+    execution = backend.list_executions(workflow_name="flaky_model.train", limit=1)[0]
+    assert backend._attempts(execution) == 2  # failed once, retried once
+
+    # zero budget: the same transient failure surfaces as FAILED
+    import shutil
+
+    shutil.rmtree(tmp_path / "flaky")
+    strict = LocalBackend(root=tmp_path / "backend2", retries=0)
+    model.remote(strict)
+    model.remote_deploy(app_version="v-flaky2")
+    with pytest.raises(BackendError, match="transient failure"):
+        model.remote_train(app_version="v-flaky2", hyperparameters={"max_iter": 100}, wait=True)

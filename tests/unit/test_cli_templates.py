@@ -13,10 +13,14 @@ from unionml_tpu.templates import list_templates, render_template
 
 
 def test_list_templates():
-    assert set(list_templates()) >= {"basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel"}
+    assert set(list_templates()) >= {
+        "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel", "serverless",
+    }
 
 
-@pytest.mark.parametrize("template", ["basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel"])
+@pytest.mark.parametrize(
+    "template", ["basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel", "serverless"]
+)
 def test_render_template_compiles(template, tmp_path):
     target = render_template(template, "my_app", tmp_path)
     app_py = target / "app.py"

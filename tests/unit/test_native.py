@@ -63,3 +63,16 @@ def test_fit_with_prefetch():
     state = create_train_state(model, params, learning_rate=1e-2)
     result = fit(state, data, batch_size=64, num_epochs=3, log_every=1000, prefetch=True)
     assert result.steps >= 9
+
+
+def test_prefetch_drop_remainder_false_yields_true_tail():
+    """Ragged tails come from the python gather, never out-of-bounds native reads."""
+    data = _data(n=100)
+    loader = PrefetchLoader(data, batch_size=64, n_slots=2, n_threads=2, drop_remainder=False)
+    perm = np.random.default_rng(5).permutation(100).astype(np.int64)
+    batches = []
+    for b, batch in enumerate(loader.epoch(rng=np.random.default_rng(5))):
+        batches.append({k: v.copy() for k, v in batch.items()})
+    assert [len(b["x"]) for b in batches] == [64, 36]
+    np.testing.assert_array_equal(batches[1]["x"], data["x"][perm[64:]])
+    loader.close()

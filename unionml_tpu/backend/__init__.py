@@ -127,12 +127,21 @@ class LocalBackend:
         project: Optional[str] = None,
         domain: Optional[str] = None,
         in_process: bool = False,
+        retries: int = 0,
     ):
+        """
+        :param retries: job-level retry budget — a failed/crashed worker is respawned
+            up to this many times before the execution is reported FAILED (the
+            failure-recovery obligation from SURVEY.md §5; the reference delegates
+            retries to Flyte).
+        """
         self.root = Path(root) if root is not None else default_backend_root()
         self.default_project = project or "default-project"
         self.default_domain = domain or "development"
         self.in_process = in_process
+        self.retries = retries
         self._workers: Dict[str, subprocess.Popen] = {}
+        self._owned: set = set()  # executions this client started (retry eligibility)
         self._base.mkdir(parents=True, exist_ok=True)
 
     # ---------------------------------------------------------------- layout
@@ -253,6 +262,7 @@ class LocalBackend:
         (exec_dir / "status").write_text(_STATUS_QUEUED)
 
         execution = Execution(execution_id, exec_dir, self)
+        self._owned.add(execution_id)
         if self.in_process:
             self._run_in_process(execution, model)
         else:
@@ -262,18 +272,30 @@ class LocalBackend:
     def _run_in_process(self, execution: Execution, model: Any) -> None:
         from unionml_tpu.backend.worker import run_workflow_for_model
 
-        (execution.directory / "status").write_text(_STATUS_RUNNING)
-        try:
-            with (execution.directory / "inputs.pkl").open("rb") as f:
-                inputs = pickle.load(f)
-            outputs = run_workflow_for_model(model, execution.metadata["workflow_name"], inputs)
-            with (execution.directory / "outputs.pkl").open("wb") as f:
-                pickle.dump(outputs, f)
-            (execution.directory / "status").write_text(_STATUS_SUCCEEDED)
-        except Exception as exc:
-            (execution.directory / "error.txt").write_text(repr(exc))
-            (execution.directory / "status").write_text(_STATUS_FAILED)
-            logger.exception("In-process execution %s failed", execution.id)
+        for attempt in range(1, self.retries + 2):
+            (execution.directory / "attempts").write_text(str(attempt))
+            (execution.directory / "status").write_text(_STATUS_RUNNING)
+            try:
+                with (execution.directory / "inputs.pkl").open("rb") as f:
+                    inputs = pickle.load(f)
+                outputs = run_workflow_for_model(model, execution.metadata["workflow_name"], inputs)
+                with (execution.directory / "outputs.pkl").open("wb") as f:
+                    pickle.dump(outputs, f)
+                (execution.directory / "status").write_text(_STATUS_SUCCEEDED)
+                return
+            except Exception as exc:
+                (execution.directory / "error.txt").write_text(repr(exc))
+                (execution.directory / "status").write_text(_STATUS_FAILED)
+                if attempt <= self.retries:
+                    logger.warning(
+                        "In-process execution %s failed (attempt %d/%d): retrying. Error: %r",
+                        execution.id,
+                        attempt,
+                        self.retries + 1,
+                        exc,
+                    )
+                else:
+                    logger.exception("In-process execution %s failed", execution.id)
 
     def _spawn_worker(self, execution: Execution) -> None:
         """Fork the worker entrypoint — the process/machine boundary (§3.2 call stack)."""
@@ -318,15 +340,48 @@ class LocalBackend:
             )
             (execution.directory / "status").write_text(_STATUS_FAILED)
 
+    def _attempts(self, execution: Execution) -> int:
+        attempts_file = execution.directory / "attempts"
+        return int(attempts_file.read_text()) if attempts_file.exists() else 1
+
+    def _maybe_retry(self, execution: Execution) -> bool:
+        """Respawn a failed worker while the retry budget lasts. True when retried.
+
+        Only executions started by THIS client are eligible: ``wait`` on a historical
+        FAILED execution is a status query and must never re-run the job.
+        """
+        if execution.id not in self._owned:
+            return False
+        attempts = self._attempts(execution)
+        if attempts > self.retries:
+            return False
+        logger.warning(
+            "Execution %s failed (attempt %d/%d): retrying. Error: %s",
+            execution.id,
+            attempts,
+            self.retries + 1,
+            execution.error,
+        )
+        (execution.directory / "attempts").write_text(str(attempts + 1))
+        (execution.directory / "error.txt").unlink(missing_ok=True)
+        (execution.directory / "status").write_text(_STATUS_QUEUED)
+        execution._outputs = None
+        self._spawn_worker(execution)
+        return True
+
     def wait(self, execution: Execution, timeout: Optional[float] = None, poll_interval: float = 0.2) -> Execution:
         deadline = None if timeout is None else time.monotonic() + timeout
-        while not execution.is_done:
-            self._reap_dead_worker(execution)
-            if execution.is_done:
-                break
-            if deadline is not None and time.monotonic() > deadline:
-                raise BackendError(f"Timed out waiting for execution {execution.id}")
-            time.sleep(poll_interval)
+        while True:
+            while not execution.is_done:
+                self._reap_dead_worker(execution)
+                if execution.is_done:
+                    break
+                if deadline is not None and time.monotonic() > deadline:
+                    raise BackendError(f"Timed out waiting for execution {execution.id}")
+                time.sleep(poll_interval)
+            if execution.status == _STATUS_FAILED and not self.in_process and self._maybe_retry(execution):
+                continue
+            break
         if execution.status == _STATUS_FAILED:
             raise BackendError(f"Execution {execution.id} failed: {execution.error}")
         return execution

@@ -129,8 +129,6 @@ def train(
     parsed = _parse_json_opt(inputs, "--inputs")
     if local:
         if profile_dir:
-            import contextlib
-
             from unionml_tpu.profiling import workflow_timings, xprof_trace
 
             with xprof_trace(profile_dir):

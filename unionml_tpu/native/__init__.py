@@ -145,17 +145,28 @@ class PrefetchLoader:
         inside the loop body.
         """
         indices = np.arange(self.n_rows, dtype=np.int64) if rng is None else rng.permutation(self.n_rows).astype(np.int64)
-        n_batches = self.n_rows // self.batch_size if self.drop_remainder else -(-self.n_rows // self.batch_size)
-        if self._handle is None or n_batches == 0:
-            # degenerate tiny datasets keep true-batch semantics (no row duplication)
-            if n_batches == 0:
+        # the native path only ever gathers FULL batches (its buffers are fixed-size);
+        # a ragged tail is yielded via the python gather below, preserving true-batch
+        # semantics with drop_remainder=False
+        n_full = self.n_rows // self.batch_size
+        remainder = self.n_rows - n_full * self.batch_size
+
+        def tail_batches():
+            if not self.drop_remainder and remainder:
+                idx = indices[n_full * self.batch_size :]
+                yield {k: a[idx] for k, a in zip(self._keys, self._arrays)}
+            elif n_full == 0:
+                # degenerate tiny datasets always yield their one true batch
                 yield {k: a[indices] for k, a in zip(self._keys, self._arrays)}
-                return
-            for b in range(n_batches):
+
+        if self._handle is None or n_full == 0:
+            for b in range(n_full):
                 idx = indices[b * self.batch_size : (b + 1) * self.batch_size]
                 yield {k: a[idx] for k, a in zip(self._keys, self._arrays)}
+            yield from tail_batches()
             return
 
+        n_batches = n_full
         indices_c = np.ascontiguousarray(indices[: n_batches * self.batch_size])
         self._lib.upf_start(
             self._handle,
@@ -179,6 +190,7 @@ class PrefetchLoader:
                     views[key] = np.array(view) if copy else view
                 yield views
                 self._lib.upf_release(self._handle, batch)
+            yield from tail_batches()
         finally:
             del indices_c
 

@@ -8,7 +8,6 @@ wall-clock duration (surfaced via :func:`workflow_timings` and the CLI's
 """
 
 import contextlib
-import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from unionml_tpu._logging import logger
@@ -32,22 +31,6 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
-
-
-class StageTimings:
-    """Collects per-stage wall-clock timings across a workflow execution."""
-
-    def __init__(self):
-        self.records: List[Dict[str, Any]] = []
-
-    def record(self, stage_name: str, duration_s: float) -> None:
-        self.records.append({"stage": stage_name, "duration_s": duration_s, "at": time.time()})
-
-    def summary(self) -> Dict[str, float]:
-        totals: Dict[str, float] = {}
-        for rec in self.records:
-            totals[rec["stage"]] = totals.get(rec["stage"], 0.0) + rec["duration_s"]
-        return totals
 
 
 def workflow_timings(workflow: Any) -> Dict[str, Optional[float]]:

@@ -18,6 +18,7 @@ _DESCRIPTIONS = {
     "mnist-cnn": "CNN image classifier trained with the compiled fit() loop",
     "bert-finetune": "BERT-base text classification fine-tune with checkpointing",
     "data-parallel": "data-parallel training over a TPU mesh (v5e-8 layout)",
+    "serverless": "digits classifier behind a FaaS event handler",
 }
 
 
