@@ -165,3 +165,26 @@ def test_bert_left_padding_exact_with_xla_impl(tiny_config):
     np.testing.assert_allclose(
         np.asarray(hidden1[:, 4:]), np.asarray(hidden2[:, 4:]), atol=1e-5
     )
+
+
+@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+def test_bert_sequence_parallel_attention_matches_xla(sp_impl):
+    """The flagship forward with ring/ulysses attention equals the exact XLA impl."""
+    import dataclasses
+
+    from unionml_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 2, "sequence": 4})
+    base_cfg = BertConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+    sp_cfg = dataclasses.replace(base_cfg, attention_impl=sp_impl, sp_mesh=mesh)
+
+    variables = init_params(base_cfg, seq_len=16)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, base_cfg.vocab_size, size=(4, 16)), dtype=jnp.int32)
+    mask = np.ones((4, 16), dtype=np.int32)
+    mask[0, 12:] = 0  # right padding
+    mask = jnp.asarray(mask)
+
+    ref = BertForSequenceClassification(base_cfg).apply(variables, ids, mask, deterministic=True)
+    out = BertForSequenceClassification(sp_cfg).apply(variables, ids, mask, deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)

@@ -111,3 +111,30 @@ def test_ring_attention_grad_flows():
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ulysses_attention_matches_full(causal):
+    from unionml_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh({"data": 2, "sequence": 4})
+    rng = np.random.default_rng(2)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(4, 8, 64, 32)), dtype=jnp.float32) for _ in range(3)
+    )
+    shd = sequence_sharding(mesh)
+    out = ulysses_attention(
+        jax.device_put(q, shd), jax.device_put(k, shd), jax.device_put(v, shd), mesh, causal=causal
+    )
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    assert out.sharding.spec == shd.spec
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from unionml_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh({"data": 2, "sequence": 4})
+    q = jnp.ones((2, 6, 32, 16))  # 6 heads not divisible by 4
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, q, q, mesh)

@@ -1,0 +1,82 @@
+"""Opaque-framework persistence: torch state_dict default saver/loader (ref model.py:1464-1511)."""
+
+from typing import List
+
+import numpy as np
+import pandas as pd
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from unionml_tpu import Dataset, Model  # noqa: E402
+
+
+class TinyTorchNet(nn.Module):
+    def __init__(self, in_dims: int = 2, hidden: int = 8):
+        super().__init__()
+        self.layers = nn.Sequential(nn.Linear(in_dims, hidden), nn.ReLU(), nn.Linear(hidden, 2))
+
+    def forward(self, x):
+        return self.layers(x)
+
+
+def make_torch_model() -> Model:
+    dataset = Dataset(name="torch_ds", targets=["y"])
+    model = Model(name="torch_model", init=TinyTorchNet, dataset=dataset)
+
+    @dataset.reader
+    def reader(n: int = 64) -> pd.DataFrame:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n, 2))
+        return pd.DataFrame({"a": x[:, 0], "b": x[:, 1], "y": (x.sum(axis=1) > 0).astype(int)})
+
+    @model.trainer
+    def trainer(net: TinyTorchNet, features: pd.DataFrame, target: pd.DataFrame) -> TinyTorchNet:
+        opt = torch.optim.Adam(net.parameters(), lr=1e-2)
+        X = torch.tensor(features.values, dtype=torch.float32)
+        y = torch.tensor(target.squeeze().values, dtype=torch.long)
+        for _ in range(30):
+            opt.zero_grad()
+            nn.functional.cross_entropy(net(X), y).backward()
+            opt.step()
+        return net
+
+    @model.predictor
+    def predictor(net: TinyTorchNet, features: pd.DataFrame) -> List[float]:
+        with torch.no_grad():
+            return [float(v) for v in net(torch.tensor(features.values, dtype=torch.float32)).argmax(1)]
+
+    @model.evaluator
+    def evaluator(net: TinyTorchNet, features: pd.DataFrame, target: pd.DataFrame) -> float:
+        preds = predictor(net, features)
+        return float(np.mean(np.asarray(preds) == target.squeeze().values))
+
+    return model
+
+
+def test_torch_train_save_load_roundtrip(tmp_path):
+    model = make_torch_model()
+    net, metrics = model.train(hyperparameters={"in_dims": 2, "hidden": 8})
+    assert metrics["train"] > 0.8
+
+    path = tmp_path / "net.pt"
+    model.save(path)
+
+    fresh = make_torch_model()
+    reloaded = fresh.load(path)
+    assert isinstance(reloaded, TinyTorchNet)
+    for p1, p2 in zip(net.parameters(), reloaded.parameters()):
+        assert torch.equal(p1, p2)
+
+    features = [{"a": 2.0, "b": 2.0}, {"a": -2.0, "b": -2.0}]
+    assert fresh.predict(features=features) == model.predict(features=features)
+
+
+def test_torch_trainer_runs_eagerly():
+    """Opaque torch objects must never be traced (the jit='auto' fallback)."""
+    model = make_torch_model()
+    model.train(hyperparameters={"in_dims": 2, "hidden": 8})
+    # evaluator is a TracedFunction with auto policy: torch input forced it eager
+    evaluator = model._evaluator
+    assert hasattr(evaluator, "uses_jit") and not evaluator.uses_jit

@@ -41,7 +41,11 @@ class BertConfig:
     attention_dropout: float = 0.1
     num_labels: int = 2
     dtype: Any = jnp.bfloat16
+    #: "auto" | "xla" | "pallas" | "ring" | "ulysses" — the last two are the
+    #: sequence-parallel long-context paths and require ``sp_mesh``
     attention_impl: str = "auto"
+    #: mesh carrying a "sequence" axis for ring/ulysses attention
+    sp_mesh: Any = None
     remat: bool = False
 
     @classmethod
@@ -81,9 +85,20 @@ class BertSelfAttention(nn.Module):
         batch, seq, _ = hidden.shape
         kv_lens, dense_mask = attn_inputs
         split = lambda x: x.reshape(batch, seq, cfg.num_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-        context = attention(
-            split(q), split(k), split(v), mask=dense_mask, kv_lens=kv_lens, impl=cfg.attention_impl
-        )
+        if cfg.attention_impl in ("ring", "ulysses"):
+            # sequence-parallel long-context path: activations shard over the mesh's
+            # "sequence" axis; padding arrives as per-batch kv_lens (right padding)
+            if cfg.sp_mesh is None:
+                raise ValueError(f"attention_impl={cfg.attention_impl!r} requires BertConfig.sp_mesh")
+            from unionml_tpu.parallel.ring import ring_attention
+            from unionml_tpu.parallel.ulysses import ulysses_attention
+
+            sp_fn = ring_attention if cfg.attention_impl == "ring" else ulysses_attention
+            context = sp_fn(split(q), split(k), split(v), cfg.sp_mesh, kv_lens=kv_lens)
+        else:
+            context = attention(
+                split(q), split(k), split(v), mask=dense_mask, kv_lens=kv_lens, impl=cfg.attention_impl
+            )
         context = context.transpose(0, 2, 1, 3).reshape(batch, seq, cfg.hidden_size)
 
         out = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(context)
@@ -162,6 +177,8 @@ class BertModel(nn.Module):
             if resolved_impl == "xla":
                 dense_mask = attention_mask[:, None, None, :].astype(bool)
             else:
+                # pallas / ring / ulysses consume per-batch lengths (right padding);
+                # the sp entrypoints default missing kv_lens to full length themselves
                 kv_lens = jnp.sum(attention_mask.astype(jnp.int32), axis=-1)
 
         word = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="word_embeddings")(

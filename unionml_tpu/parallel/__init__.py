@@ -6,6 +6,8 @@ collectives — the TPU-native replacement for an NCCL/MPI backend (SURVEY.md §
 """
 
 from unionml_tpu.parallel.dp import batches, data_parallel_eval, data_parallel_step, pad_to_multiple
+from unionml_tpu.parallel.ring import ring_attention, sequence_sharding
+from unionml_tpu.parallel.ulysses import ulysses_attention
 from unionml_tpu.parallel.mesh import (
     DATA_AXIS,
     FSDP_AXIS,
@@ -35,5 +37,8 @@ __all__ = [
     "make_mesh",
     "pad_to_multiple",
     "replicated",
+    "ring_attention",
+    "sequence_sharding",
     "shard_batch",
+    "ulysses_attention",
 ]

@@ -18,7 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
+from jax import lax  # noqa: F401 - lax used throughout
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from unionml_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
@@ -26,16 +26,21 @@ from unionml_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 _NEG_INF = -1e30
 
 
-def _local_block_attention(q, k_blk, v_blk, acc, row_max, row_sum, q_offset, k_offset, causal, sm_scale):
+def _local_block_attention(
+    q, k_blk, v_blk, acc, row_max, row_sum, q_offset, k_offset, causal, sm_scale, kv_lens=None
+):
     """Fold one visiting K/V block into the online-softmax accumulator.
 
     q: (b, h, Lq, d); k_blk/v_blk: (b, h, Lk, d); accumulators broadcast alike.
-    Offsets are the global sequence positions of the local shards (for causal masks).
+    Offsets are the global sequence positions of the local shards (for causal and
+    padding masks). ``kv_lens`` is a (b,) per-batch valid length (right padding).
     """
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32) * sm_scale
+    k_pos = k_offset + lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    if kv_lens is not None:
+        scores = jnp.where(k_pos < kv_lens[:, None, None, None], scores, _NEG_INF)
     if causal:
         q_pos = q_offset + lax.broadcasted_iota(jnp.int32, scores.shape, 2)
-        k_pos = k_offset + lax.broadcasted_iota(jnp.int32, scores.shape, 3)
         scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
 
     block_max = jnp.max(scores, axis=-1, keepdims=True)
@@ -49,7 +54,7 @@ def _local_block_attention(q, k_blk, v_blk, acc, row_max, row_sum, q_offset, k_o
     return acc, new_max, row_sum
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
+def _ring_attention_local(q, k, v, kv_lens, *, axis_name: str, causal: bool, sm_scale: float):
     """Per-device body: rotate K/V around the ring, folding blocks as they arrive."""
     axis_size = lax.psum(1, axis_name)
     my_index = lax.axis_index(axis_name)
@@ -76,6 +81,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: fl
             k_offset=src_index * local_len,
             causal=causal,
             sm_scale=sm_scale,
+            kv_lens=kv_lens,
         )
         # hand our current block to the right neighbor (ICI neighbor exchange)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
@@ -88,12 +94,27 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: fl
     return (acc / jnp.maximum(row_sum, 1e-30)).astype(q.dtype)
 
 
+def _sp_prologue(q, mesh, sm_scale, seq_axis, batch_axis, kv_lens):
+    """Shared setup for the sequence-parallel entrypoints (ring + ulysses).
+
+    Returns (softmax scale, activation spec, kv_lens spec, kv_lens-with-default).
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    batch = batch_axis if batch_axis in mesh.axis_names else None
+    spec = P(batch, None, seq_axis, None)
+    lens_spec = P(batch)
+    if kv_lens is None:
+        kv_lens = jnp.full((q.shape[0],), q.shape[-2], dtype=jnp.int32)
+    return scale, spec, lens_spec, kv_lens
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     mesh: Mesh,
     *,
+    kv_lens: Optional[jax.Array] = None,
     causal: bool = False,
     sm_scale: Optional[float] = None,
     seq_axis: str = SEQUENCE_AXIS,
@@ -102,22 +123,20 @@ def ring_attention(
     """Sequence-parallel attention over ``mesh``'s ``seq_axis``.
 
     Inputs are (batch, heads, seq, head_dim); ``seq`` must divide the sequence-axis
-    size. Batch is sharded over ``batch_axis`` when present. The result carries the
-    same sharding as ``q``.
+    size. Batch is sharded over ``batch_axis`` when present. ``kv_lens`` is a (batch,)
+    valid-length vector (right-padding mask). The result carries ``q``'s sharding.
     """
-    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    batch = batch_axis if batch_axis in mesh.axis_names else None
-    spec = P(batch, None, seq_axis, None)
+    scale, spec, lens_spec, kv_lens = _sp_prologue(q, mesh, sm_scale, seq_axis, batch_axis, kv_lens)
 
     body = functools.partial(_ring_attention_local, axis_name=seq_axis, causal=causal, sm_scale=scale)
     mapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, lens_spec),
         out_specs=spec,
         check_vma=False,
     )
-    return mapped(q, k, v)
+    return mapped(q, k, v, kv_lens)
 
 
 def sequence_sharding(mesh: Mesh, batch_axis: str = DATA_AXIS, seq_axis: str = SEQUENCE_AXIS) -> NamedSharding:

@@ -1,0 +1,75 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-sharding.
+
+The second long-context strategy next to :mod:`unionml_tpu.parallel.ring`: instead of
+rotating K/V blocks, one ``all_to_all`` over the sequence axis re-shards activations
+from sequence-sharded (each device: all heads, seq/N positions) to head-sharded (each
+device: heads/N, full sequence). Attention then runs *unmodified* on full sequences for
+the local head subset — any mask works, no online-softmax bookkeeping — and a second
+all-to-all restores sequence sharding.
+
+Trade-off vs ring: two all-to-alls of the full activations (ICI-friendly) but O(seq)
+activation memory per device for its head subset, while ring keeps O(seq/N) memory and
+overlaps its N-1 neighbor permutes with compute. The sequence-axis size must divide
+the head count (e.g. 8 heads on a 4-way axis).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: F401 - P re-exported pattern
+
+from unionml_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+from unionml_tpu.parallel.ring import _sp_prologue
+
+
+def _ulysses_local(q, k, v, kv_lens, *, axis_name: str, causal: bool, sm_scale: float):
+    # deferred: unionml_tpu.ops pulls in pallas, which only the sp hot path needs
+    from unionml_tpu.ops.attention import xla_attention
+
+    # (b, h, s/N, d) -> (b, h/N, s, d): split heads across the axis, gather sequence
+    to_heads = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    seq_k = k.shape[-2]
+    mask = (jnp.arange(seq_k)[None, :] < kv_lens[:, None])[:, None, None, :]
+    out = xla_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+    # (b, h/N, s, d) -> (b, h, s/N, d)
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    kv_lens: Optional[jax.Array] = None,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    seq_axis: str = SEQUENCE_AXIS,
+    batch_axis: str = DATA_AXIS,
+) -> jax.Array:
+    """Sequence-parallel attention via head/sequence all-to-all re-sharding.
+
+    Inputs are (batch, heads, seq, head_dim) sharded over ``seq_axis`` on the sequence
+    dimension; ``heads`` must be divisible by the axis size. ``kv_lens`` is a (batch,)
+    valid-length vector (right-padding mask). Output keeps the input sharding.
+    """
+    axis_size = mesh.shape[seq_axis]
+    heads = q.shape[1]
+    if heads % axis_size:
+        raise ValueError(
+            f"ulysses_attention requires heads ({heads}) divisible by the {seq_axis!r} "
+            f"axis size ({axis_size}); use ring_attention otherwise."
+        )
+    scale, spec, lens_spec, kv_lens = _sp_prologue(q, mesh, sm_scale, seq_axis, batch_axis, kv_lens)
+
+    body = functools.partial(_ulysses_local, axis_name=seq_axis, causal=causal, sm_scale=scale)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec, lens_spec), out_specs=spec, check_vma=False
+    )(q, k, v, kv_lens)

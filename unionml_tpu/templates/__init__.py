@@ -19,6 +19,7 @@ _DESCRIPTIONS = {
     "bert-finetune": "BERT-base text classification fine-tune with checkpointing",
     "data-parallel": "data-parallel training over a TPU mesh (v5e-8 layout)",
     "serverless": "digits classifier behind a FaaS event handler",
+    "torch-digits": "pytorch MLP digits classifier (opaque-trainer path)",
 }
 
 
