@@ -1,0 +1,57 @@
+"""App whose trainer proves it ran on a multi-host mesh (device count + global reduce)."""
+
+from typing import Dict, List
+
+import numpy as np
+import pandas as pd
+
+from unionml_tpu import Dataset, Model
+
+dataset = Dataset(name="mh_dataset", targets=["y"])
+
+
+def init(scale: float = 1.0) -> dict:
+    return {"scale": scale}
+
+
+model = Model(name="mh_model", init=init, dataset=dataset)
+
+
+@dataset.reader
+def reader(n: int = 32) -> pd.DataFrame:
+    rng = np.random.default_rng(0)
+    return pd.DataFrame({"x": rng.normal(size=n), "y": rng.integers(0, 2, size=n)})
+
+
+@model.trainer
+def trainer(obj: dict, features: pd.DataFrame, target: pd.DataFrame) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from unionml_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": jax.device_count()})
+    rows_per_host = 4
+    local = np.full((rows_per_host, 2), float(jax.process_index() + 1), dtype=np.float32)
+    sharding = NamedSharding(mesh, PartitionSpec("data", None))
+    garr = jax.make_array_from_process_local_data(
+        sharding, local, (rows_per_host * jax.process_count(), 2)
+    )
+    total = float(jax.jit(jnp.sum)(garr))
+    return {
+        "scale": obj["scale"],
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+        "global_total": total,
+    }
+
+
+@model.predictor
+def predictor(obj: dict, features: pd.DataFrame) -> List[float]:
+    return [obj["scale"]] * len(features)
+
+
+@model.evaluator
+def evaluator(obj: dict, features: pd.DataFrame, target: pd.DataFrame) -> float:
+    return float(obj["device_count"])

@@ -1,0 +1,50 @@
+"""Per-process body for the multi-host integration test.
+
+Run as: python multihost_worker.py <process_id> <num_processes> <coordinator>
+Each process owns 4 virtual CPU devices; after ``initialize_distributed`` the global
+mesh spans all processes and a pjit-sharded computation reduces across them (DCN in
+production; TCP here).
+"""
+
+import os
+import sys
+
+process_id, num_processes, coordinator = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from unionml_tpu.parallel import make_mesh, shard_batch  # noqa: E402
+from unionml_tpu.parallel.distributed import initialize_distributed, is_primary_host  # noqa: E402
+
+initialize_distributed(coordinator_address=coordinator, num_processes=num_processes, process_id=process_id)
+assert jax.process_count() == num_processes, jax.process_count()
+assert jax.device_count() == 4 * num_processes, jax.device_count()
+
+mesh = make_mesh({"data": jax.device_count()})
+
+# global array sharded across both processes: each host contributes its local rows
+rows_per_host = 8
+global_shape = (rows_per_host * num_processes, 4)
+local = np.full((rows_per_host, 4), float(process_id + 1), dtype=np.float32)
+from jax.sharding import NamedSharding, PartitionSpec
+
+sharding = NamedSharding(mesh, PartitionSpec("data", None))
+garr = jax.make_array_from_process_local_data(sharding, local, global_shape)
+
+
+@jax.jit
+def global_sum(x):
+    return jnp.sum(x)
+
+
+total = float(global_sum(garr))
+expected = float(sum((p + 1) * rows_per_host * 4 for p in range(num_processes)))
+assert total == expected, (total, expected)
+
+if is_primary_host():
+    print(f"MULTIHOST_OK devices={jax.device_count()} total={total}")

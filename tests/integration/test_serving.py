@@ -21,9 +21,9 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from unionml_tpu.utils import pick_free_port
+
+    return pick_free_port()
 
 
 def _wait_for_health(port: int, timeout: float = 30.0) -> dict:

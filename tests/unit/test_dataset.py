@@ -189,3 +189,47 @@ def test_from_sqlite(tmp_path):
     assert isinstance(raw, pd.DataFrame) and len(raw) == 10
     data = dataset.get_data(raw)
     assert len(data["train"][0]) == 8
+
+
+def test_sql_reader_full_train_predict(tmp_path):
+    """Full train+predict through a SQL reader (ref test_sqltask_reader.py:43-93)."""
+    import sqlite3
+
+    from sklearn.linear_model import LogisticRegression
+
+    from unionml_tpu import Model
+
+    db = tmp_path / "train.db"
+    rng = np.random.default_rng(2)
+    with sqlite3.connect(db) as conn:
+        conn.execute("CREATE TABLE points (a REAL, b REAL, y INTEGER)")
+        rows = [
+            (float(x1), float(x2), int(x1 + x2 > 0))
+            for x1, x2 in rng.normal(size=(60, 2))
+        ]
+        conn.executemany("INSERT INTO points VALUES (?, ?, ?)", rows)
+
+    dataset = Dataset.from_sqlite(
+        str(db), "SELECT * FROM points LIMIT :limit", query_params={"limit": int},
+        name="sql_train_ds", targets=["y"],
+    )
+    model = Model(name="sql_model", init=LogisticRegression, dataset=dataset)
+
+    @model.trainer
+    def trainer(est: LogisticRegression, X: pd.DataFrame, y: pd.DataFrame) -> LogisticRegression:
+        return est.fit(X, y.squeeze())
+
+    @model.predictor
+    def predictor(est: LogisticRegression, X: pd.DataFrame) -> List[float]:
+        return [float(v) for v in est.predict(X)]
+
+    @model.evaluator
+    def evaluator(est: LogisticRegression, X: pd.DataFrame, y: pd.DataFrame) -> float:
+        return float(est.score(X, y.squeeze()))
+
+    _, metrics = model.train(hyperparameters={"max_iter": 200}, limit=60)
+    assert metrics["train"] > 0.8
+    predictions = model.predict(limit=10)  # reader-driven prediction re-queries the DB
+    assert len(predictions) == 10
+    predictions = model.predict(features=[{"a": 3.0, "b": 3.0}])
+    assert predictions == [1.0]

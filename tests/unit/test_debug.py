@@ -1,0 +1,44 @@
+"""Debug utility tests: nan guard, purity assertion, retrace monitor."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.debug import RetraceMonitor, assert_pure, check_tracer_leaks, debug_nans
+
+
+def test_debug_nans_raises_at_source():
+    with debug_nans():
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: x / 0.0 * 0.0)(jnp.float32(1.0))
+    # restored afterwards: same op runs silently
+    jax.jit(lambda x: x / 0.0 * 0.0)(jnp.float32(1.0))
+
+
+def test_assert_pure_accepts_pure_and_rejects_stateful():
+    assert_pure(lambda x: x * 2 + 1, jnp.arange(4.0))
+
+    state = {"calls": 0}
+
+    def impure(x):
+        state["calls"] += 1
+        return x + state["calls"]  # python-side counter frozen at trace time
+
+    with pytest.raises(AssertionError):
+        assert_pure(impure, jnp.arange(4.0))
+
+
+def test_retrace_monitor_counts_signatures():
+    monitor = RetraceMonitor(lambda x: x * 2, name="double")
+    monitor(jnp.ones((4,)))
+    monitor(jnp.ones((4,)))  # cached: no new trace
+    assert monitor.traces == 1
+    monitor(jnp.ones((8,)))  # new shape: re-trace
+    assert monitor.traces == 2
+
+
+def test_check_tracer_leaks_context():
+    with check_tracer_leaks():
+        jax.jit(lambda x: x + 1)(1.0)  # clean function passes
+    assert not jax.config.jax_check_tracer_leaks

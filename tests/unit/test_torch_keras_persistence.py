@@ -80,3 +80,18 @@ def test_torch_trainer_runs_eagerly():
     # evaluator is a TracedFunction with auto policy: torch input forced it eager
     evaluator = model._evaluator
     assert hasattr(evaluator, "uses_jit") and not evaluator.uses_jit
+
+
+def test_keras_default_saver_loader(tmp_path):
+    """Keras model default persistence (ref model.py:1474-1476, 1512-1515)."""
+    keras = pytest.importorskip("keras")
+
+    from unionml_tpu.checkpoint import default_load, default_save
+
+    net = keras.Sequential([keras.layers.Input((4,)), keras.layers.Dense(2)])
+    path = tmp_path / "model.keras"
+    default_save(net, {"lr": 1e-3}, path)
+    reloaded = default_load(path, model_type=type(net))
+    assert isinstance(reloaded, keras.Model)
+    x = np.ones((3, 4), dtype=np.float32)
+    np.testing.assert_allclose(net.predict(x, verbose=0), reloaded.predict(x, verbose=0), atol=1e-6)

@@ -264,6 +264,11 @@ class LocalBackend:
         execution = Execution(execution_id, exec_dir, self)
         self._owned.add(execution_id)
         if self.in_process:
+            if int((meta.get("resources") or {}).get("host_count", 1) or 1) > 1:
+                raise BackendError(
+                    "host_count > 1 requires worker subprocesses; in_process backends "
+                    "cannot run multi-host jobs."
+                )
             self._run_in_process(execution, model)
         else:
             self._spawn_worker(execution)
@@ -298,17 +303,60 @@ class LocalBackend:
                     logger.exception("In-process execution %s failed", execution.id)
 
     def _spawn_worker(self, execution: Execution) -> None:
-        """Fork the worker entrypoint — the process/machine boundary (§3.2 call stack)."""
-        with (execution.directory / "worker.log").open("w") as log_file:
-            process = subprocess.Popen(
-                [sys.executable, "-m", "unionml_tpu.backend.worker", str(execution.directory)],
-                stdout=log_file,
-                stderr=subprocess.STDOUT,
-                cwd=os.getcwd(),
-            )
-        # keep the handle: poll() both reaps the child (no zombie) and detects crashes
-        self._workers[execution.id] = process
-        (execution.directory / "pid").write_text(str(process.pid))
+        """Fork the worker entrypoint(s) — the process/machine boundary (§3.2 call stack).
+
+        Jobs whose resource spec declares ``host_count > 1`` spawn one worker per host
+        with ``jax.distributed`` coordination env (the local stand-in for a multi-host
+        TPU slice, where each host runs the same entrypoint); host 0 owns outputs and
+        status.
+        """
+        host_count = int((execution.metadata.get("resources") or {}).get("host_count", 1) or 1)
+        if host_count <= 1:
+            with (execution.directory / "worker.log").open("w") as log_file:
+                process = subprocess.Popen(
+                    [sys.executable, "-m", "unionml_tpu.backend.worker", str(execution.directory)],
+                    stdout=log_file,
+                    stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                )
+            # keep the handles: poll() reaps children (no zombies) and detects crashes
+            self._workers[execution.id] = [process]
+            (execution.directory / "pid").write_text(str(process.pid))
+            return
+
+        from unionml_tpu.utils import pick_free_port
+
+        coordinator = f"127.0.0.1:{pick_free_port()}"
+        fleet = []
+        for host in range(host_count):
+            env = {
+                **os.environ,
+                "JAX_COORDINATOR_ADDRESS": coordinator,
+                "JAX_NUM_PROCESSES": str(host_count),
+                "JAX_PROCESS_ID": str(host),
+            }
+            with (execution.directory / f"worker-{host}.log").open("w") as log_file:
+                process = subprocess.Popen(
+                    [sys.executable, "-m", "unionml_tpu.backend.worker", str(execution.directory)],
+                    stdout=log_file,
+                    stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                    env=env,
+                )
+            fleet.append(process)
+        self._workers[execution.id] = fleet
+        (execution.directory / "pid").write_text(str(fleet[0].pid))
+
+    def _terminate_workers(self, execution_id: str, timeout: float = 5.0) -> None:
+        """Kill every worker of an execution (before retries; on fleet failure)."""
+        for process in self._workers.pop(execution_id, []):
+            if process.poll() is None:
+                process.terminate()
+                try:
+                    process.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    process.kill()
+                    process.wait()
 
     def _reap_dead_worker(self, execution: Execution) -> None:
         """Failure detection: mark an execution FAILED if its worker died without a status.
@@ -319,11 +367,17 @@ class LocalBackend:
         client waiting on the same store) are checked via /proc, treating zombie state
         as dead.
         """
-        process = self._workers.get(execution.id)
-        if process is not None:
-            if process.poll() is None:
+        fleet = self._workers.get(execution.id)
+        if fleet is not None:
+            if all(process.poll() is None for process in fleet):
                 return
-            self._workers.pop(execution.id, None)  # exited: drop the handle
+            if any(process.poll() is None for process in fleet):
+                # part of a multi-host fleet died: the survivors are stuck in
+                # collectives — bring the whole job down so FAILED is deterministic
+                logger.warning("Execution %s: a worker died; terminating the fleet.", execution.id)
+                self._terminate_workers(execution.id)
+            else:
+                self._workers.pop(execution.id, None)  # all exited: drop the handles
             dead = True
         else:
             pid_file = execution.directory / "pid"
@@ -362,6 +416,7 @@ class LocalBackend:
             self.retries + 1,
             execution.error,
         )
+        self._terminate_workers(execution.id)  # no stale fleet racing the respawn
         (execution.directory / "attempts").write_text(str(attempts + 1))
         (execution.directory / "error.txt").unlink(missing_ok=True)
         (execution.directory / "status").write_text(_STATUS_QUEUED)

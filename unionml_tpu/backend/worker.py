@@ -74,25 +74,40 @@ def run_execution(execution_dir: Path) -> int:
         meta = json.load(f)
     (execution_dir / "status").write_text("RUNNING")
 
+    host_index = 0
     try:
+        primary = True
         resources = meta.get("resources") or {}
-        if resources.get("host_count", 1) > 1:
+        if (resources.get("host_count") or 1) > 1:
+            import jax
+
             from unionml_tpu.parallel.distributed import initialize_distributed
 
-            initialize_distributed()
+            # strict: a silent single-process fallback would run N uncoordinated
+            # copies of the job, each believing it is primary
+            initialize_distributed(strict=True)
+            primary = jax.process_index() == 0
+            host_index = jax.process_index()
 
         model = load_tracked_instance(meta["app_module"], meta["app_variable"], meta.get("module_file"))
         with (execution_dir / "inputs.pkl").open("rb") as f:
             inputs = pickle.load(f)
         outputs = run_workflow_for_model(model, meta["workflow_name"], inputs)
-        with (execution_dir / "outputs.pkl").open("wb") as f:
-            pickle.dump(outputs, f)
-        (execution_dir / "status").write_text("SUCCEEDED")
+        # every host runs the SPMD body; only host 0 owns outputs and terminal status
+        if primary:
+            with (execution_dir / "outputs.pkl").open("wb") as f:
+                pickle.dump(outputs, f)
+            (execution_dir / "status").write_text("SUCCEEDED")
         return 0
     except Exception as exc:  # record failure for the waiting client
         logger.exception("Worker failed for execution %s", meta.get("execution_id"))
-        (execution_dir / "error.txt").write_text(repr(exc))
-        (execution_dir / "status").write_text("FAILED")
+        (execution_dir / f"error-host{host_index}.txt").write_text(repr(exc))
+        status_file = execution_dir / "status"
+        # never demote a completed job: host 0 may have already written SUCCEEDED
+        # before a secondary host failed post-hoc
+        if not (status_file.exists() and status_file.read_text().strip() == "SUCCEEDED"):
+            (execution_dir / "error.txt").write_text(repr(exc))
+            status_file.write_text("FAILED")
         return 1
 
 

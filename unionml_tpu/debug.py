@@ -1,0 +1,80 @@
+"""Debug & sanitization utilities: the jit-era analogues of race detectors.
+
+Reference state: no sanitizers exist (SURVEY.md §5 — concurrency in-framework is nil).
+In a compiled framework the corresponding failure modes are impure traced functions
+(side effects silently frozen at trace time), NaN-producing steps, and accidental
+retracing; these helpers surface each.
+"""
+
+import contextlib
+from typing import Any, Callable, Iterator
+
+import jax
+
+from unionml_tpu._logging import logger
+
+
+@contextlib.contextmanager
+def debug_nans(enabled: bool = True) -> Iterator[None]:
+    """Raise at the op that first produces a NaN inside jitted code."""
+    previous = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enabled)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", previous)
+
+
+@contextlib.contextmanager
+def check_tracer_leaks() -> Iterator[None]:
+    """Error on traced values escaping their trace (the classic impurity bug)."""
+    previous = jax.config.jax_check_tracer_leaks
+    jax.config.update("jax_check_tracer_leaks", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_check_tracer_leaks", previous)
+
+
+def assert_pure(fn: Callable, *example_args: Any, atol: float = 1e-5) -> None:
+    """Assert ``fn`` is trace-pure: eager and compiled evaluations agree in structure
+    and values (``atol=0`` demands exact equality).
+
+    Catches functions that read mutable global state or mutate inputs — those behave
+    differently between eager calls and their once-traced compiled form.
+    """
+    import numpy as np
+
+    eager = fn(*example_args)
+    compiled = jax.jit(fn)(*example_args)
+    eager_tree = jax.tree_util.tree_structure(eager)
+    compiled_tree = jax.tree_util.tree_structure(compiled)
+    assert eager_tree == compiled_tree, (
+        f"output structure differs between eager ({eager_tree}) and traced ({compiled_tree}) evaluation"
+    )
+    for e_leaf, c_leaf in zip(jax.tree_util.tree_leaves(eager), jax.tree_util.tree_leaves(compiled)):
+        np.testing.assert_allclose(np.asarray(e_leaf), np.asarray(c_leaf), atol=atol)
+
+
+class RetraceMonitor:
+    """Counts how often a jitted function re-traces (shape/dtype churn detector).
+
+    Excess retracing is the compiled-framework performance bug: every new input
+    signature pays full compilation. Wrap the function, run the workload, then check
+    ``monitor.traces`` — more than a handful means the input pipeline leaks shapes.
+    """
+
+    def __init__(self, fn: Callable, name: str = None):
+        self.traces = 0
+        self.name = name or getattr(fn, "__name__", "fn")
+
+        def counted(*args, **kwargs):
+            self.traces += 1
+            if self.traces > 1:
+                logger.warning("%s re-traced (trace #%d) — check for shape/dtype churn", self.name, self.traces)
+            return fn(*args, **kwargs)
+
+        self.wrapped = jax.jit(counted)
+
+    def __call__(self, *args, **kwargs):
+        return self.wrapped(*args, **kwargs)

@@ -22,17 +22,20 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
-) -> None:
-    """Idempotently initialize ``jax.distributed``.
+    strict: bool = False,
+) -> bool:
+    """Idempotently initialize ``jax.distributed``; returns True when initialized.
 
     On TPU VMs created as one slice, ``jax.distributed.initialize()`` auto-discovers
     everything from the TPU metadata server; explicit args (or the standard
     ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` env vars)
-    cover manual fleets.
+    cover manual fleets. ``strict=True`` re-raises init failures — REQUIRED for
+    multi-host jobs: a silent single-process fallback would make every host believe it
+    is primary and run N uncoordinated copies of the job.
     """
     global _initialized
     if _initialized:
-        return
+        return True
 
     coordinator_address = coordinator_address or os.getenv("JAX_COORDINATOR_ADDRESS")
     num_processes = num_processes if num_processes is not None else _int_env("JAX_NUM_PROCESSES")
@@ -55,9 +58,13 @@ def initialize_distributed(
             jax.local_device_count(),
             jax.device_count(),
         )
+        return True
     except (RuntimeError, ValueError) as exc:
+        if strict:
+            raise
         # single-process contexts (unit tests, one-host slices) are fine without init
         logger.info("jax.distributed not initialized (%s); continuing single-process.", exc)
+        return False
 
 
 def _int_env(name: str) -> Optional[int]:

@@ -57,6 +57,15 @@ def module_is_installed(module: str) -> bool:
         return False
 
 
+def pick_free_port() -> int:
+    """Reserve an ephemeral localhost port (bind-probe; small TOCTOU window applies)."""
+    import socket
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        return probe.getsockname()[1]
+
+
 def to_device_arrays(*arrays: Any, dtype: Any = None) -> Tuple[jax.Array, ...]:
     """Convert host data (pandas / numpy / lists) to device arrays.
 
