@@ -1,0 +1,94 @@
+"""App versioning from git (ref ``remote.py:45-59``): sha, dirty-tree guard, patch."""
+
+import subprocess
+
+import pytest
+
+from unionml_tpu.exceptions import VersionFetchError
+from unionml_tpu.remote import get_app_version
+
+
+@pytest.fixture()
+def git_repo(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t", "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    subprocess.run(["git", "init", "-q"], check=True)
+    (tmp_path / "app.py").write_text("x = 1\n")
+    subprocess.run(["git", "add", "-A"], check=True)
+    subprocess.run(["git", "commit", "-q", "-m", "init"], check=True, env={**env, "PATH": "/usr/bin:/bin"})
+    return tmp_path
+
+
+def test_clean_tree_returns_sha(git_repo):
+    version = get_app_version()
+    sha = subprocess.run(["git", "rev-parse", "HEAD"], capture_output=True, text=True).stdout.strip()
+    assert version == sha[:12]
+    assert "-dirty" not in version
+
+
+def test_dirty_tree_requires_opt_in(git_repo):
+    (git_repo / "app.py").write_text("x = 2\n")
+    with pytest.raises(VersionFetchError, match="uncommitted"):
+        get_app_version()
+    version = get_app_version(allow_uncommitted=True)
+    assert version.endswith("-dirty")
+
+
+def test_outside_repo_raises(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(VersionFetchError, match="git"):
+        get_app_version()
+
+
+def test_deploy_patch_version_suffix(git_repo, monkeypatch, tmp_path):
+    """Patch deployment appends -patch<uuid> to the sha (ref model.py:1019)."""
+    import sys
+
+    sys.path.insert(0, str(git_repo))
+    try:
+        (git_repo / "patch_app.py").write_text(
+            "import pandas as pd\n"
+            "from sklearn.linear_model import LogisticRegression\n"
+            "from typing import List\n"
+            "from unionml_tpu import Dataset, Model\n"
+            "dataset = Dataset(name='p_ds', targets=['y'])\n"
+            "model = Model(name='p_model', init=LogisticRegression, dataset=dataset)\n"
+            "@dataset.reader\n"
+            "def reader() -> pd.DataFrame:\n"
+            "    return pd.DataFrame({'a': [0.0, 1.0], 'y': [0, 1]})\n"
+            "@model.trainer\n"
+            "def trainer(e: LogisticRegression, X: pd.DataFrame, y: pd.DataFrame) -> LogisticRegression:\n"
+            "    return e\n"
+            "@model.predictor\n"
+            "def predictor(e: LogisticRegression, X: pd.DataFrame) -> List[float]:\n"
+            "    return []\n"
+            "@model.evaluator\n"
+            "def evaluator(e: LogisticRegression, X: pd.DataFrame, y: pd.DataFrame) -> float:\n"
+            "    return 0.0\n"
+        )
+        subprocess.run(["git", "add", "-A"], check=True)
+        subprocess.run(
+            ["git", "commit", "-q", "-m", "app"],
+            check=True,
+            env={
+                "PATH": "/usr/bin:/bin",
+                "GIT_AUTHOR_NAME": "t",
+                "GIT_AUTHOR_EMAIL": "t@t",
+                "GIT_COMMITTER_NAME": "t",
+                "GIT_COMMITTER_EMAIL": "t@t",
+            },
+        )
+
+        import importlib
+
+        patch_app = importlib.import_module("patch_app")
+        from unionml_tpu.backend import LocalBackend
+
+        patch_app.model.remote(LocalBackend(root=tmp_path / "backend"))
+        version = patch_app.model.remote_deploy(patch=True)
+        sha = subprocess.run(["git", "rev-parse", "HEAD"], capture_output=True, text=True).stdout.strip()
+        assert version.startswith(sha[:12])
+        assert "-patch" in version
+    finally:
+        sys.path.remove(str(git_repo))
+        sys.modules.pop("patch_app", None)

@@ -11,6 +11,11 @@ schedules.
 from unionml_tpu.dataset import Dataset
 from unionml_tpu.model import BaseHyperparameters, Model, ModelArtifact
 
-__version__ = "0.1.0"
+try:  # installed-package metadata wins (reference __init__.py version-from-metadata parity)
+    from importlib.metadata import version as _pkg_version
+
+    __version__ = _pkg_version("unionml-tpu")
+except Exception:  # source checkout
+    __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Model", "ModelArtifact", "BaseHyperparameters", "__version__"]

@@ -9,6 +9,11 @@ from unionml_tpu.services.event_handler import make_event_handler
 from unionml_tpu.utils import module_is_installed
 
 if module_is_installed("bentoml"):
-    from unionml_tpu.services.bentoml_service import BentoMLService  # noqa: F401
+    from unionml_tpu.services.bentoml_service import (  # noqa: F401
+        BentoMLService,
+        create_runnable,
+        create_service,
+        infer_io_descriptors,
+    )
 
 __all__ = ["make_event_handler"]

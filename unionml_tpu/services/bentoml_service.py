@@ -19,6 +19,48 @@ from unionml_tpu._logging import logger
 from unionml_tpu.serving.resident import ResidentPredictor
 
 
+def infer_io_descriptors(model: Any):
+    """Infer bentoml input/output IO descriptors from the app's types.
+
+    Reference parity: ``services/bentoml.py:216-247``. The INPUT is always JSON: the
+    API handler receives the raw wire payload and routes it through the dataset's
+    feature pipeline (which owns deserialization), so the input descriptor must not
+    pre-coerce it — only the OUTPUT descriptor is inferred from the predictor's
+    return annotation (DataFrames -> PandasDataFrame, arrays -> NumpyNdarray).
+    """
+    import numpy as np
+    import pandas as pd
+
+    def descriptor(tp):
+        try:
+            if isinstance(tp, type) and issubclass(tp, pd.DataFrame):
+                return bentoml.io.PandasDataFrame()
+            if isinstance(tp, type) and issubclass(tp, np.ndarray):
+                return bentoml.io.NumpyNdarray()
+        except TypeError:
+            pass
+        module = getattr(tp, "__module__", "")
+        if module.startswith(("jax", "jaxlib")):
+            return bentoml.io.NumpyNdarray()
+        return bentoml.io.JSON()
+
+    try:
+        prediction_type = model.prediction_type  # raises when no predictor registered yet
+    except TypeError:
+        prediction_type = None
+    return bentoml.io.JSON(), descriptor(prediction_type)
+
+
+def create_runnable(model: Any, tag: str) -> type:
+    """Function-form runnable factory (``services/bentoml.py:create_runnable`` parity)."""
+    return BentoMLService(model).create_runnable(tag)
+
+
+def create_service(model: Any, tag: str, name: str = None, enable_async: bool = False):
+    """Function-form service factory (``services/bentoml.py:create_service`` parity)."""
+    return BentoMLService(model).configure(tag, name=name, enable_async=enable_async)
+
+
 class BentoMLService:
     """Binds a unionml-tpu Model to bentoml save/load/serve."""
 
@@ -86,7 +128,8 @@ class BentoMLService:
         self._runner = bentoml.Runner(runnable, name=f"{self._model.name}-runner")
         svc = bentoml.Service(name or self._model.name, runners=[self._runner])
         handler = self._make_api(enable_async)
-        svc.api(input=bentoml.io.JSON(), output=bentoml.io.JSON())(handler)
+        input_io, output_io = infer_io_descriptors(self._model)
+        svc.api(input=input_io, output=output_io)(handler)
         self._svc = svc
         return svc
 
