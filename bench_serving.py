@@ -1,0 +1,81 @@
+"""Serving-latency microbench: resident-predictor p50/p99 (BASELINE.md metric 2).
+
+Measures the in-process request path — feature pipeline, pad-to-bucket, resident
+compiled executable, device->host — for single-row requests against a jax MLP model.
+Prints one JSON line: {"metric": "resident_predict_p50_ms", ...}. Not driver-invoked
+(bench.py carries the headline metric); kept for tracking the serving path round over
+round.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import pandas as pd
+
+    from unionml_tpu import Dataset, Model
+    from unionml_tpu.serving import ResidentPredictor
+
+    n_features = 64
+    feature_names = [f"f{i}" for i in range(n_features)]
+    dataset = Dataset(name="bench_ds", features=feature_names, targets=["y"], device_format="jax")
+
+    def init(scale: float = 1.0) -> dict:
+        rng = np.random.default_rng(0)
+        return {
+            "w1": jnp.asarray(rng.normal(size=(n_features, 128)) * 0.1, dtype=jnp.float32),
+            "w2": jnp.asarray(rng.normal(size=(128, 10)) * 0.1, dtype=jnp.float32),
+        }
+
+    model = Model(name="bench_model", init=init, dataset=dataset)
+
+    @dataset.reader
+    def reader(n: int = 256) -> pd.DataFrame:
+        rng = np.random.default_rng(0)
+        frame = pd.DataFrame(rng.normal(size=(n, n_features)).astype(np.float32), columns=feature_names)
+        frame["y"] = rng.integers(0, 10, size=n)
+        return frame
+
+    @model.trainer
+    def trainer(params: dict, X: jax.Array, y: jax.Array) -> dict:
+        return params
+
+    @model.predictor
+    def predictor(params: dict, X: jax.Array) -> jax.Array:
+        return jnp.argmax(jax.nn.relu(X @ params["w1"]) @ params["w2"], axis=-1)
+
+    @model.evaluator
+    def evaluator(params: dict, X: jax.Array, y: jax.Array) -> float:
+        return 0.0
+
+    model.train()
+    resident = ResidentPredictor(model, warmup=True)
+    resident.setup()
+
+    request = [dict(zip(feature_names, np.random.default_rng(1).normal(size=n_features)))]
+    resident.predict(features=request)  # compile the size-1 bucket
+
+    latencies = []
+    for _ in range(200):
+        t0 = time.perf_counter()
+        resident.predict(features=request)
+        latencies.append((time.perf_counter() - t0) * 1e3)
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    p99 = latencies[int(len(latencies) * 0.99)]
+    print(f"[bench_serving] backend={jax.default_backend()} p50={p50:.3f}ms p99={p99:.3f}ms", file=sys.stderr)
+    print(
+        json.dumps(
+            {"metric": "resident_predict_p50_ms", "value": round(p50, 3), "unit": "ms", "p99_ms": round(p99, 3)}
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

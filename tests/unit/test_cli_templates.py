@@ -14,13 +14,17 @@ from unionml_tpu.templates import list_templates, render_template
 
 def test_list_templates():
     assert set(list_templates()) >= {
-        "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel", "serverless", "torch-digits",
+        "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
+        "serverless", "torch-digits", "keras-mnist",
     }
 
 
 @pytest.mark.parametrize(
     "template",
-    ["basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel", "serverless", "torch-digits"],
+    [
+        "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
+        "serverless", "torch-digits", "keras-mnist",
+    ],
 )
 def test_render_template_compiles(template, tmp_path):
     target = render_template(template, "my_app", tmp_path)

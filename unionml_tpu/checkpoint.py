@@ -157,7 +157,7 @@ def default_load(
         model.load_state_dict(payload["model_obj"])
         return model
     if model_type is not None and is_keras_model(model_type):
-        from tensorflow import keras  # pragma: no cover - keras optional in this env
+        import keras  # standalone keras 3; also provided by tensorflow installs
 
         return keras.models.load_model(file)
 

@@ -20,6 +20,7 @@ _DESCRIPTIONS = {
     "data-parallel": "data-parallel training over a TPU mesh (v5e-8 layout)",
     "serverless": "digits classifier behind a FaaS event handler",
     "torch-digits": "pytorch MLP digits classifier (opaque-trainer path)",
+    "keras-mnist": "Keras MNIST CNN (the reference tutorial recipe, opaque path)",
 }
 
 
