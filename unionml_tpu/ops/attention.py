@@ -6,9 +6,12 @@ implementations behind one dispatcher:
 
 - ``impl="pallas"``: blocked flash attention (online softmax) keeping the working set
   in VMEM, f32 accumulation on the MXU, O(seq) memory. Grid: (batch*heads, q_blocks);
-  the KV scan runs inside the kernel with ``jax.lax.fori_loop``.
-- ``impl="xla"``: the standard fused-by-XLA softmax(QK^T)V — also the backward path of
-  the pallas forward (rematerialized), so autodiff works everywhere.
+  the KV scan runs inside the kernel with ``jax.lax.fori_loop``. The BACKWARD is also
+  pallas: the forward saves per-row logsumexp residuals and the dq / dk+dv kernels
+  recompute probabilities blockwise (flash-attention-2 style), so training never
+  materializes the (seq x seq) score matrix either.
+- ``impl="xla"``: the standard fused-by-XLA softmax(QK^T)V — the exact reference, the
+  dense-mask path, and the fallback for non-tile-aligned shapes (fwd and bwd).
 - ``impl="auto"``: pallas on TPU backends, XLA elsewhere (CPU tests run the fallback).
 
 Shapes follow the (batch, num_heads, seq, head_dim) convention.
@@ -58,6 +61,7 @@ def _flash_kernel(
     k_ref,
     v_ref,
     o_ref,
+    lse_ref=None,
     *,
     block_k: int,
     seq_k: int,
@@ -68,7 +72,9 @@ def _flash_kernel(
     """One (batch*head, q_block) program: stream KV blocks with an online softmax.
 
     ``kv_len_ref`` is a scalar (SMEM) per-batch valid KV length implementing the
-    padding mask: K positions >= kv_len contribute nothing.
+    padding mask: K positions >= kv_len contribute nothing. When pallas passes a
+    second output ref (``lse_ref``), the per-row logsumexp is written as the backward
+    residual.
     """
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, head_dim)
     q_index = pl.program_id(1)
@@ -111,6 +117,16 @@ def _flash_kernel(
         last_block = jnp.minimum(last_block, pl.cdiv((q_index + 1) * block_q, block_k))
     acc, row_max, row_sum = jax.lax.fori_loop(0, last_block, body, (acc, row_max, row_sum))
     o_ref[0] = (acc / jnp.maximum(row_sum, 1e-30)).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # logsumexp of the (scaled, masked) scores — the residual the backward needs
+        lse = row_max + jnp.log(jnp.maximum(row_sum, 1e-30))
+        lse_ref[0] = lse.reshape(lse_ref.shape[1:]).astype(jnp.float32)
+
+
+def _tile_aligned(seq_q: int, seq_k: int, head_dim: int, block_q: int, block_k: int) -> bool:
+    # irregular shapes fall back to XLA for exactness; head_dim down to 64 is allowed
+    # (mosaic pads the lane dim), smaller/odd head dims are not worth the kernel
+    return not (seq_q % block_q or seq_k % block_k or head_dim % 64)
 
 
 def _flash_forward(
@@ -123,15 +139,15 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jax.Array:
+    return_residuals: bool = False,
+):
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[-2]
 
-    # irregular shapes fall back to XLA for exactness; head_dim down to 64 is allowed
-    # (mosaic pads the lane dim), smaller/odd head dims are not worth the kernel
-    if seq_q % block_q or seq_k % block_k or head_dim % 64:
+    if not _tile_aligned(seq_q, seq_k, head_dim, block_q, block_k):
         mask = _kv_lens_to_mask(kv_lens, seq_k) if kv_lens is not None else None
-        return xla_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+        out = xla_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+        return (out, None) if return_residuals else out
 
     bh = batch * heads
     q3 = q.reshape(bh, seq_q, head_dim)
@@ -149,7 +165,12 @@ def _flash_forward(
         sm_scale=sm_scale,
         block_q=block_q,
     )
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))]
+    if return_residuals:
+        out_shape.append(jax.ShapeDtypeStruct((bh, seq_q), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q), lambda b, i: (b, i)))
+    result = pl.pallas_call(
         kernel,
         grid=(bh, seq_q // block_q),
         in_specs=[
@@ -158,8 +179,8 @@ def _flash_forward(
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        out_specs=out_specs if return_residuals else out_specs[0],
+        out_shape=out_shape if return_residuals else out_shape[0],
         cost_estimate=pl.CostEstimate(
             flops=4 * bh * seq_q * seq_k * head_dim,
             bytes_accessed=(q3.size + k3.size + v3.size + q3.size) * q3.dtype.itemsize,
@@ -167,13 +188,222 @@ def _flash_forward(
         ),
         interpret=interpret,
     )(kv_lens_bh, q3, k3, v3)
-    return out.reshape(batch, heads, seq_q, head_dim)
+    if return_residuals:
+        out, lse = result
+        return out.reshape(batch, heads, seq_q, head_dim), lse.reshape(batch, heads, seq_q)
+    return result.reshape(batch, heads, seq_q, head_dim)
 
 
 def _kv_lens_to_mask(kv_lens: jax.Array, seq_k: int) -> jax.Array:
     """(batch,) valid lengths -> (batch, 1, 1, seq_k) boolean padding mask."""
     positions = jnp.arange(seq_k)[None, :]
     return (positions < kv_lens[:, None])[:, None, None, :]
+
+
+def _bwd_dq_kernel(
+    kv_len_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    *,
+    block_k: int,
+    seq_k: int,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+):
+    """dQ for one (batch*head, q_block): stream KV blocks, recompute probabilities."""
+    qs = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d); scores are pre-scaled
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].reshape(block_q, 1)
+    delta = delta_ref[0].reshape(block_q, 1)
+    q_index = pl.program_id(1)
+    kv_len = kv_len_ref[0]
+
+    dq = jnp.zeros((block_q, qs.shape[-1]), dtype=jnp.float32)
+    num_k_blocks = seq_k // block_k
+
+    def body(k_idx, dq):
+        k_block = k_ref[0, pl.ds(k_idx * block_k, block_k), :].astype(jnp.float32)
+        v_block = v_ref[0, pl.ds(k_idx * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            qs, k_block, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        k_pos = k_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = q_index * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        probs = jnp.where(valid, jnp.exp(scores - lse), 0.0)
+        dp = jax.lax.dot_general(do, v_block, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        dscores = probs * (dp - delta)
+        return dq + jax.lax.dot_general(
+            dscores, k_block, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    last_block = jnp.minimum(num_k_blocks, pl.cdiv(kv_len, block_k))
+    if causal:
+        last_block = jnp.minimum(last_block, pl.cdiv((q_index + 1) * block_q, block_k))
+    dq = jax.lax.fori_loop(0, last_block, body, dq)
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    kv_len_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    *,
+    block_q: int,
+    seq_q: int,
+    causal: bool,
+    sm_scale: float,
+    block_k: int,
+):
+    """dK/dV for one (batch*head, kv_block): stream Q blocks, recompute probabilities."""
+    k_block = k_ref[0].astype(jnp.float32)  # (block_k, d)
+    v_block = v_ref[0].astype(jnp.float32)
+    kv_index = pl.program_id(1)
+    kv_len = kv_len_ref[0]
+
+    dk = jnp.zeros_like(k_block)
+    dv = jnp.zeros_like(v_block)
+    num_q_blocks = seq_q // block_q
+
+    def body(q_idx, carry):
+        dk, dv = carry
+        qs = q_ref[0, pl.ds(q_idx * block_q, block_q), :].astype(jnp.float32) * sm_scale
+        do = do_ref[0, pl.ds(q_idx * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(q_idx * block_q, block_q)].reshape(block_q, 1)
+        delta = delta_ref[0, pl.ds(q_idx * block_q, block_q)].reshape(block_q, 1)
+
+        scores = jax.lax.dot_general(
+            qs, k_block, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        k_pos = kv_index * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        probs = jnp.where(valid, jnp.exp(scores - lse), 0.0)
+
+        dv = dv + jax.lax.dot_general(
+            probs, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(do, v_block, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        dscores = probs * (dp - delta)
+        # qs already carries sm_scale, so this is the gradient wrt the original K
+        dk = dk + jax.lax.dot_general(
+            dscores, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    # causal: q blocks strictly above this kv block's diagonal contribute nothing;
+    # kv blocks entirely beyond kv_len (padding tail) skip the whole scan
+    first_block = (kv_index * block_k) // block_q if causal else 0
+    in_range = kv_index * block_k < kv_len
+    last_block = jnp.where(in_range, num_q_blocks, first_block)
+    dk, dv = jax.lax.fori_loop(first_block, last_block, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_lens: Optional[jax.Array],
+    out: jax.Array,
+    lse: jax.Array,
+    g: jax.Array,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    """Pallas flash backward: dq/dk/dv with O(seq) memory, probabilities recomputed."""
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[-2]
+    bh = batch * heads
+
+    reshape3 = lambda x: x.reshape(bh, x.shape[-2], x.shape[-1])
+    q3, k3, v3, do3 = reshape3(q), reshape3(k), reshape3(v), reshape3(g)
+    lse3 = lse.reshape(bh, seq_q)
+    # delta_i = rowsum(dO * O): the softmax-jacobian correction term
+    delta3 = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1).reshape(bh, seq_q)
+    if kv_lens is None:
+        kv_lens_bh = jnp.full((bh,), seq_k, dtype=jnp.int32)
+    else:
+        kv_lens_bh = jnp.repeat(kv_lens.astype(jnp.int32), heads)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_k=block_k, seq_k=seq_k, causal=causal, sm_scale=sm_scale, block_q=block_q
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, i: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * bh * seq_q * seq_k * head_dim,  # scores + dp + dq matmuls
+            bytes_accessed=(q3.size + k3.size + v3.size + 2 * do3.size) * q3.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
+        ),
+        interpret=interpret,
+    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=block_q, seq_q=seq_q, causal=causal, sm_scale=sm_scale, block_k=block_k
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, j: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, seq_q, head_dim), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, seq_q, head_dim), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, head_dim), v.dtype),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=8 * bh * seq_q * seq_k * head_dim,  # scores + dv + dp + dk matmuls
+            bytes_accessed=(2 * q3.size + k3.size + v3.size + 2 * do3.size) * q3.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k,
+        ),
+        interpret=interpret,
+    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3)
+
+    unshape = lambda x, s: x.reshape(batch, heads, s, head_dim)
+    return unshape(dq, seq_q), unshape(dk, seq_k), unshape(dv, seq_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -188,7 +418,11 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
-    """Blocked flash attention (pallas). Differentiable: backward rematerializes via XLA.
+    """Blocked flash attention (pallas), fully differentiable.
+
+    Backward also runs pallas kernels (probabilities recomputed from the saved
+    logsumexp residual — O(seq) memory both ways); irregular shapes fall back to the
+    XLA path in both directions.
 
     :param kv_lens: optional (batch,) int32 valid KV lengths — the padding-mask case
         (keys at positions >= kv_lens[b] are masked for every head/query of batch b).
@@ -198,13 +432,24 @@ def flash_attention(
 
 
 def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v, kv_lens)
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    out, lse = _flash_forward(
+        q, k, v, kv_lens, causal, scale, block_q, block_k, interpret, return_residuals=True
+    )
+    # the XLA-fallback backward recomputes from q/k/v: don't keep `out` alive for it
+    residual_out = out if lse is not None else None
+    return out, (q, k, v, kv_lens, residual_out, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
-    q, k, v, kv_lens = residuals
+    q, k, v, kv_lens, out, lse = residuals
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    if lse is not None:
+        dq, dk, dv = _flash_backward(
+            q, k, v, kv_lens, out, lse, g, causal, scale, block_q, block_k, interpret
+        )
+        return dq, dk, dv, None
+    # irregular-shape path: differentiate the XLA reference instead
     mask = _kv_lens_to_mask(kv_lens, k.shape[-2]) if kv_lens is not None else None
     _, vjp = jax.vjp(
         lambda q_, k_, v_: xla_attention(q_, k_, v_, mask=mask, causal=causal, sm_scale=scale), q, k, v
