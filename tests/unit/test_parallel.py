@@ -138,3 +138,52 @@ def test_ulysses_rejects_indivisible_heads():
     q = jnp.ones((2, 6, 32, 16))  # 6 heads not divisible by 4
     with pytest.raises(ValueError, match="divisible"):
         ulysses_attention(q, q, q, mesh)
+
+
+def test_pipeline_apply_matches_sequential():
+    """GPipe microbatching over the stage axis equals sequential stage application."""
+    from unionml_tpu.parallel.pp import pipeline_apply
+
+    mesh = make_mesh({"data": 2, "stage": 4})
+    rng = np.random.default_rng(0)
+    Ws = jnp.asarray(rng.normal(size=(4, 16, 16)) * 0.3, dtype=jnp.float32)
+    bs = jnp.asarray(rng.normal(size=(4, 16)) * 0.1, dtype=jnp.float32)
+
+    def stage_fn(params, h):
+        W, b = params
+        return jax.nn.relu(h @ W + b)
+
+    x = jnp.asarray(rng.normal(size=(16, 16)), dtype=jnp.float32)
+    out = pipeline_apply(stage_fn, (Ws, bs), x, mesh, num_microbatches=8)
+    ref = x
+    for s in range(4):
+        ref = stage_fn((Ws[s], bs[s]), ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_pipeline_apply_validations():
+    from unionml_tpu.parallel.pp import pipeline_apply
+
+    mesh = make_mesh({"data": 2, "stage": 4})
+    Ws = jnp.ones((4, 8, 8))
+    with pytest.raises(ValueError, match="must evenly divide"):
+        pipeline_apply(lambda w, h: h @ w, Ws, jnp.ones((10, 8)), mesh, num_microbatches=3)
+    with pytest.raises(ValueError, match="leading axis"):
+        pipeline_apply(lambda w, h: h @ w, jnp.ones((3, 8, 8)), jnp.ones((8, 8)), mesh, num_microbatches=4)
+
+
+def test_moe_apply_matches_per_token_dispatch():
+    """Expert-sharded MoE equals gathering each token's assigned expert."""
+    from unionml_tpu.parallel.ep import moe_apply
+
+    mesh = make_mesh({"data": 2, "expert": 4})
+    rng = np.random.default_rng(1)
+    eW = jnp.asarray(rng.normal(size=(8, 16, 12)) * 0.3, dtype=jnp.float32)
+    tokens = jnp.asarray(rng.normal(size=(32, 16)), dtype=jnp.float32)
+    assignment = jnp.asarray(rng.integers(0, 8, size=(32,)), dtype=jnp.int32)
+    out = moe_apply(lambda W, t: t @ W, eW, tokens, assignment, mesh)
+    ref = jnp.stack([tokens[i] @ eW[assignment[i]] for i in range(32)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    with pytest.raises(ValueError, match="divisible"):
+        moe_apply(lambda W, t: t @ W, jnp.ones((6, 4, 4)), tokens[:, :4], assignment, mesh)

@@ -6,6 +6,8 @@ collectives — the TPU-native replacement for an NCCL/MPI backend (SURVEY.md §
 """
 
 from unionml_tpu.parallel.dp import batches, data_parallel_eval, data_parallel_step, pad_to_multiple
+from unionml_tpu.parallel.ep import expert_sharding, moe_apply
+from unionml_tpu.parallel.pp import pipeline_apply, stage_sharding
 from unionml_tpu.parallel.ring import ring_attention, sequence_sharding
 from unionml_tpu.parallel.ulysses import ulysses_attention
 from unionml_tpu.parallel.mesh import (
@@ -32,7 +34,11 @@ __all__ = [
     "batches",
     "data_parallel_eval",
     "data_parallel_step",
+    "expert_sharding",
     "logical_to_sharding",
+    "moe_apply",
+    "pipeline_apply",
+    "stage_sharding",
     "make_hybrid_mesh",
     "make_mesh",
     "pad_to_multiple",

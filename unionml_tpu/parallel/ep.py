@@ -1,0 +1,80 @@
+"""Expert parallelism: a mixture-of-experts layer sharded over an ``"expert"`` mesh axis.
+
+Each device owns ``experts_per_device`` expert MLPs (parameters sharded on their
+leading expert axis); tokens are routed top-1 by an external gating assignment. The
+dispatch is dense-masked: every device computes its local experts over the full token
+set, masks by assignment, and a ``psum`` over the expert axis combines the shards —
+the simplest exact EP layout (all-to-all token dispatch is the optimization, not a
+semantic change; queued as future work in NEXT.md).
+"""
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+EXPERT_AXIS = "expert"
+
+
+def _moe_local(expert_params, tokens, assignment, *, expert_fn, axis_name: str, experts_per_device: int):
+    """Per-device body: run local experts on all tokens, mask, combine via psum."""
+    device_index = lax.axis_index(axis_name)
+    out = jnp.zeros(tokens.shape[:-1] + (_out_dim(expert_fn, expert_params, tokens),), dtype=tokens.dtype)
+    for local_e in range(experts_per_device):
+        global_e = device_index * experts_per_device + local_e
+        params_e = jax.tree_util.tree_map(lambda p: p[local_e], expert_params)
+        expert_out = expert_fn(params_e, tokens)
+        mask = (assignment == global_e)[..., None].astype(tokens.dtype)
+        out = out + expert_out * mask
+    return lax.psum(out, axis_name)
+
+
+def _out_dim(expert_fn, expert_params, tokens):
+    params_0 = jax.tree_util.tree_map(lambda p: p[0], expert_params)
+    return jax.eval_shape(expert_fn, params_0, tokens).shape[-1]
+
+
+def moe_apply(
+    expert_fn: Callable,
+    stacked_params: Any,
+    tokens: jax.Array,
+    assignment: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = EXPERT_AXIS,
+) -> jax.Array:
+    """Apply a top-1-routed mixture of experts sharded over ``axis``.
+
+    :param expert_fn: ``(params, tokens) -> outputs`` applied per expert.
+    :param stacked_params: pytree with a leading ``num_experts`` axis; sharded over
+        ``axis`` (``num_experts`` must divide by the axis size).
+    :param tokens: (..., d_model) token activations (replicated).
+    :param assignment: (...,) int32 expert index per token (the router's argmax).
+    """
+    num_devices = mesh.shape[axis]
+    num_experts = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if num_experts % num_devices:
+        raise ValueError(
+            f"num_experts ({num_experts}) must be divisible by the {axis!r} axis size ({num_devices})"
+        )
+    experts_per_device = num_experts // num_devices
+
+    params_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    body = functools.partial(
+        _moe_local, expert_fn=expert_fn, axis_name=axis, experts_per_device=experts_per_device
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(params_spec, P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, tokens, assignment)
+
+
+def expert_sharding(mesh: Mesh, axis: str = EXPERT_AXIS) -> NamedSharding:
+    """Sharding for stacked per-expert parameters (leading expert axis)."""
+    return NamedSharding(mesh, P(axis))

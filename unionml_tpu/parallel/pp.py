@@ -1,0 +1,116 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``"stage"`` mesh axis.
+
+Homogeneous-stage pipelining (the transformer-layers case): per-stage parameters are
+stacked on a leading axis and sharded over ``stage``; microbatches flow device-to-device
+via ``lax.ppermute`` (ICI neighbor exchange). The schedule runs
+``num_microbatches + num_stages - 1`` ticks; at tick t, stage s computes microbatch
+``t - s`` (the classic GPipe fill/steady/drain). Each device COMPUTES on one
+microbatch per tick (compute O(batch/M) at a time); note that in this first version
+the input and output buffers are replicated across stages for schedule simplicity, so
+per-device BUFFER memory is O(batch) — stage-0-only feeding and per-tick collection
+are the queued optimization (NEXT.md).
+
+SURVEY.md §2 marks PP "future work" for the reference rebuild; here it lands as a
+composable primitive (the dryrun exercises it alongside dp/fsdp/tp/sp).
+"""
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+STAGE_AXIS = "stage"
+
+
+def _pipeline_local(stage_params, x_mb, *, stage_fn, axis_name: str, num_microbatches: int):
+    """Per-device schedule: consume at stage 0, compute own stage, pass rightward."""
+    num_stages = lax.psum(1, axis_name)
+    stage_index = lax.axis_index(axis_name)
+    stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)  # drop stage dim
+
+    mb_shape = x_mb.shape[1:]
+    outputs = jnp.zeros((num_microbatches,) + mb_shape, dtype=x_mb.dtype)
+    carry = jnp.zeros(mb_shape, dtype=x_mb.dtype)
+    perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def tick(t, state):
+        outputs, carry = state
+        feed_index = jnp.clip(t, 0, num_microbatches - 1)
+        # stage 0 consumes a fresh microbatch; later stages consume the handoff
+        h_in = jnp.where(stage_index == 0, x_mb[feed_index], carry)
+        h_out = stage_fn(stage_params, h_in)
+        # collect at the last stage once the pipeline has filled (t >= num_stages - 1)
+        out_index = jnp.clip(t - (num_stages - 1), 0, num_microbatches - 1)
+        is_output_tick = jnp.logical_and(stage_index == num_stages - 1, t >= num_stages - 1)
+        outputs = jnp.where(
+            is_output_tick,
+            outputs.at[out_index].set(h_out),
+            outputs,
+        )
+        carry = lax.ppermute(h_out, axis_name, perm)
+        return outputs, carry
+
+    total_ticks = num_microbatches + num_stages - 1
+    outputs, _ = lax.fori_loop(0, total_ticks, tick, (outputs, carry))
+    # only the last stage holds real outputs; psum replicates them across the axis
+    outputs = jnp.where(stage_index == num_stages - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    axis: str = STAGE_AXIS,
+) -> jax.Array:
+    """Apply ``num_stages`` instances of ``stage_fn`` as a GPipe pipeline.
+
+    :param stage_fn: ``(params, h) -> h`` with matching input/output shapes
+        (homogeneous stages — the stacked-transformer-layers case).
+    :param stacked_params: pytree whose leaves carry a leading ``num_stages`` axis,
+        sharded over ``axis``.
+    :param x: (batch, ...) input; ``num_microbatches`` must evenly divide ``batch``.
+    :param num_microbatches: pipeline fill granularity; per-tick compute per stage
+        scales with ``batch / num_microbatches`` while bubble fraction scales with
+        ``(num_stages - 1) / (num_microbatches + num_stages - 1)``. Input/output
+        buffers are currently replicated across stages (O(batch) buffer memory).
+    :returns: (batch, ...) output, replicated over the stage axis.
+    """
+    num_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            f"num_microbatches ({num_microbatches}) must evenly divide batch ({batch})"
+        )
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != num_stages:
+            raise ValueError(
+                f"stacked_params leading axis ({leaf.shape[0]}) must equal the {axis!r} "
+                f"mesh axis size ({num_stages})"
+            )
+
+    x_mb = x.reshape((num_microbatches, batch // num_microbatches) + x.shape[1:])
+
+    params_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    body = functools.partial(
+        _pipeline_local, stage_fn=stage_fn, axis_name=axis, num_microbatches=num_microbatches
+    )
+    out_mb = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(params_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x_mb)
+    return out_mb.reshape((batch,) + x.shape[1:])
+
+
+def stage_sharding(mesh: Mesh, axis: str = STAGE_AXIS) -> NamedSharding:
+    """Sharding for stacked per-stage parameters (leading stage axis)."""
+    return NamedSharding(mesh, P(axis))
