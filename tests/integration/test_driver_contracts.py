@@ -1,0 +1,60 @@
+"""Driver-contract tests: bench.py's single JSON line and __graft_entry__'s two hooks.
+
+These mirror exactly what the round driver runs, so regressions surface in CI rather
+than at judging time.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _run(cmd, env_extra=None, timeout=420):
+    env = {
+        "PATH": "/usr/bin:/bin",
+        "HOME": "/tmp",
+        "PYTHONPATH": str(REPO_ROOT),
+        "JAX_PLATFORMS": "cpu",
+        **(env_extra or {}),
+    }
+    return subprocess.run(
+        cmd, env=env, cwd=REPO_ROOT, capture_output=True, text=True, timeout=timeout
+    )
+
+
+def test_bench_emits_single_json_line():
+    result = _run([sys.executable, "bench.py"])
+    assert result.returncode == 0, result.stderr[-2000:]
+    lines = [line for line in result.stdout.splitlines() if line.strip()]
+    assert len(lines) == 1, f"stdout must carry exactly one line, got: {lines}"
+    payload = json.loads(lines[0])
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+    assert payload["value"] > 0
+
+
+def test_graft_entry_single_chip():
+    script = (
+        "import jax, __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n"
+        "print('ENTRY_OK', out.shape)\n"
+    )
+    result = _run([sys.executable, "-c", script])
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "ENTRY_OK (8, 2)" in result.stdout
+
+
+def test_graft_entry_dryrun_multichip():
+    script = "import __graft_entry__ as g; g.dryrun_multichip(8)\n"
+    result = _run(
+        [sys.executable, "-c", script],
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "dryrun_multichip OK" in result.stdout
+    for phase in ("ring_attention", "pipeline", "moe"):
+        assert phase in result.stdout
