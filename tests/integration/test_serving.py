@@ -135,3 +135,29 @@ def test_serving_missing_model_path_fails_loudly(tmp_path):
         raise
     assert server.returncode != 0
     assert "Model artifact path not specified" in output
+
+
+def test_concurrent_requests_coalesce(served_model):
+    """Parallel clients get correct results and share compiled predictor calls."""
+    import concurrent.futures
+
+    port, _ = served_model
+    _wait_for_health(port)
+
+    payloads = [
+        {"features": [{"x1": float(i), "x2": float(i)}, {"x1": -float(i + 1), "x2": -float(i + 1)}]}
+        for i in range(12)
+    ]
+    with concurrent.futures.ThreadPoolExecutor(max_workers=12) as pool:
+        results = list(pool.map(lambda p: _post_predict(port, p), payloads))
+    for i, preds in enumerate(results):
+        expected_hi = 1.0 if i > 0 else preds[0]  # x1=x2=0 sits on the boundary
+        assert preds[1] == 0.0
+        if i > 0:
+            assert preds[0] == expected_hi
+
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats", timeout=5) as resp:
+        stats = json.loads(resp.read())
+    assert stats["resident"] is True
+    assert stats["coalescing"]["requests"] >= 12
+    assert stats["coalescing"]["batches"] <= stats["coalescing"]["requests"]

@@ -1,0 +1,142 @@
+"""Request-coalescing batcher tests (asyncio, no HTTP)."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from unionml_tpu.serving.batcher import RequestBatcher
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_concurrent_requests_share_batches():
+    calls = []
+
+    def predict_rows(rows):
+        calls.append(len(rows))
+        time.sleep(0.01)  # give stragglers time to queue behind the first batch
+        return [r * 10 for r in rows]
+
+    async def scenario():
+        batcher = RequestBatcher(predict_rows, max_batch=64, max_wait_ms=20)
+        results = await asyncio.gather(*[batcher.submit([i, i + 100]) for i in range(8)])
+        batcher.close()
+        return results
+
+    results = _run(scenario())
+    assert results == [[i * 10, (i + 100) * 10] for i in range(8)]
+    assert sum(calls) == 16
+    assert len(calls) < 8, f"expected coalescing, got one call per request: {calls}"
+
+
+def test_max_batch_bounds_flush_size():
+    calls = []
+
+    def predict_rows(rows):
+        calls.append(len(rows))
+        return rows
+
+    async def scenario():
+        batcher = RequestBatcher(predict_rows, max_batch=4, max_wait_ms=50)
+        results = await asyncio.gather(*[batcher.submit([i, i]) for i in range(6)])
+        batcher.close()
+        return results
+
+    results = _run(scenario())
+    assert [r for pair in results for r in pair] == [i for i in range(6) for _ in range(2)]
+    assert max(calls) <= 4 + 1  # a request's rows are never split across batches
+
+
+def test_result_count_mismatch_fails_requests():
+    async def scenario():
+        batcher = RequestBatcher(lambda rows: rows[:-1], max_batch=8, max_wait_ms=1)
+        with pytest.raises(ValueError, match="one result per row"):
+            await batcher.submit([1, 2, 3])
+        batcher.close()
+
+    _run(scenario())
+
+
+def test_predictor_exception_propagates():
+    def boom(rows):
+        raise RuntimeError("kaput")
+
+    async def scenario():
+        batcher = RequestBatcher(boom, max_batch=8, max_wait_ms=1)
+        with pytest.raises(RuntimeError, match="kaput"):
+            await batcher.submit([1])
+        batcher.close()
+
+    _run(scenario())
+
+
+def test_stats_accumulate():
+    async def scenario():
+        batcher = RequestBatcher(lambda rows: rows, max_batch=64, max_wait_ms=5)
+        await asyncio.gather(*[batcher.submit([1, 2]) for _ in range(4)])
+        stats = dict(batcher.stats)
+        batcher.close()
+        return stats
+
+    stats = _run(scenario())
+    assert stats["requests"] == 4
+    assert stats["rows"] == 8
+    assert 1 <= stats["batches"] <= 4
+
+
+def test_dataframe_output_splits_by_rows_not_columns():
+    """Mapping/column-iteration outputs must never masquerade as row predictions."""
+    import pandas as pd
+
+    def predict_df(rows):
+        return pd.DataFrame({"prob": [0.5] * len(rows), "label": list(range(len(rows)))})
+
+    async def scenario():
+        batcher = RequestBatcher(predict_df, max_batch=8, max_wait_ms=10)
+        a, b = await asyncio.gather(batcher.submit([1]), batcher.submit([2]))
+        batcher.close()
+        return a, b
+
+    a, b = _run(scenario())
+    assert a == [{"prob": 0.5, "label": 0}]
+    assert b == [{"prob": 0.5, "label": 1}]
+
+
+def test_mapping_output_rejected():
+    async def scenario():
+        batcher = RequestBatcher(lambda rows: {"a": 1, "b": 2, "c": 3}, max_batch=8, max_wait_ms=1)
+        with pytest.raises(ValueError, match="mapping"):
+            await batcher.submit([1, 2, 3])
+        batcher.close()
+
+    _run(scenario())
+
+
+def test_close_fails_queued_requests_instead_of_hanging():
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_predict(rows):
+        started.set()
+        release.wait(5)
+        return rows
+
+    async def scenario():
+        batcher = RequestBatcher(slow_predict, max_batch=1, max_wait_ms=1)
+        first = asyncio.create_task(batcher.submit([1]))
+        await asyncio.get_running_loop().run_in_executor(None, started.wait, 5)
+        second = asyncio.create_task(batcher.submit([2]))  # stuck behind the slow flush
+        await asyncio.sleep(0.05)
+        batcher.close()
+        release.set()
+        results = await asyncio.gather(first, second, return_exceptions=True)
+        return results
+
+    first_result, second_result = _run(scenario())
+    # the in-flight request either completes or fails cleanly; the queued one must fail
+    assert isinstance(second_result, Exception) or second_result == [2]
+    assert not isinstance(first_result, asyncio.CancelledError)

@@ -81,12 +81,27 @@ def build_aiohttp_app(
     app_version: Optional[str] = None,
     model_version: str = "latest",
     resident: bool = True,
+    coalesce: bool = True,
+    max_batch: int = 64,
+    max_wait_ms: float = 2.0,
 ):
-    """Create the aiohttp application with a resident predictor."""
+    """Create the aiohttp application with a resident predictor.
+
+    ``coalesce=True`` merges concurrent row-list ``features`` requests into shared
+    predictor calls (see :mod:`unionml_tpu.serving.batcher`); requests whose payloads
+    don't fit the row-list contract fall back to per-request prediction.
+    """
     from aiohttp import web
 
     app = web.Application()
     predictor = ResidentPredictor(model) if resident else None
+    batcher = None
+    if coalesce and predictor is not None:
+        from unionml_tpu.serving.batcher import RequestBatcher
+
+        batcher = RequestBatcher(
+            lambda rows: predictor.predict(features=rows), max_batch=max_batch, max_wait_ms=max_wait_ms
+        )
 
     async def on_startup(app):
         load_model_artifact(model, remote=remote, app_version=app_version, model_version=model_version)
@@ -94,7 +109,12 @@ def build_aiohttp_app(
             predictor.setup()
         logger.info("Serving app ready (model=%s).", model.name)
 
+    async def on_cleanup(app):
+        if batcher is not None:
+            batcher.close()
+
     app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
 
     async def index(request):
         return web.Response(text=_INDEX_HTML, content_type="text/html")
@@ -113,28 +133,49 @@ def build_aiohttp_app(
         features = payload.get("features")
         if inputs is None and features is None:
             return web.json_response({"detail": "inputs or features must be supplied."}, status=500)
+        import asyncio
+
+        loop = asyncio.get_running_loop()
         try:
             if inputs:
-                result = (
-                    predictor.predict(**inputs) if predictor is not None else model.predict(**inputs)
+                # off the event loop: compiled predictor calls block for milliseconds+
+                result = await loop.run_in_executor(
+                    None,
+                    lambda: predictor.predict(**inputs) if predictor is not None else model.predict(**inputs),
                 )
             else:
-                # model.predict runs the feature pipeline itself; don't pre-process here
-                result = (
-                    predictor.predict(features=features)
-                    if predictor is not None
-                    else model.predict(features=features)
-                )
+                result = None
+                if batcher is not None and isinstance(features, list):
+                    try:
+                        result = await batcher.submit(features)
+                    except Exception as exc:
+                        logger.info("Coalesced path failed (%s); serving this request directly.", exc)
+                if result is None:
+                    # model.predict runs the feature pipeline itself; don't pre-process here
+                    result = await loop.run_in_executor(
+                        None,
+                        lambda: predictor.predict(features=features)
+                        if predictor is not None
+                        else model.predict(features=features),
+                    )
             return web.json_response(jsonable(result))
         except Exception as exc:
             logger.exception("Prediction failed")
             return web.json_response({"detail": f"Prediction failed: {exc}"}, status=500)
 
+    async def stats(request):
+        payload = {"model": model.name, "resident": predictor is not None}
+        if batcher is not None:
+            payload["coalescing"] = dict(batcher.stats)
+        return web.json_response(payload)
+
     app.router.add_get("/", index)
     app.router.add_get("/health", health)
+    app.router.add_get("/stats", stats)
     app.router.add_post("/predict", predict)
     app["unionml_model"] = model
     app["resident_predictor"] = predictor
+    app["request_batcher"] = batcher
     return app
 
 
