@@ -10,8 +10,10 @@ Two layers of defense, because a site shim may import jax eagerly at interpreter
 start and register remote TPU plugins whose transport can be unavailable in CI:
 
 1. env vars set before jax would normally load (fresh interpreters);
-2. if jax is already imported but backends are not yet initialized, deregister every
-   non-CPU backend factory so no remote plugin is dialed during tests.
+2. if jax is already imported, repoint ``jax.config``'s ``jax_platforms`` to ``cpu``
+   so backend init never dials the remote plugin. (Plugins stay REGISTERED: removing
+   their factories would drop 'tpu' from jax's known platforms and break
+   pallas/checkify lowering registration at import time.)
 """
 
 import os
@@ -27,14 +29,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 if "jax" in sys.modules:
     try:
         import jax
-        import jax._src.xla_bridge as _xb
 
         # jax.config captured JAX_PLATFORMS at its original import; repoint it to cpu
+        # so backend init never dials the remote plugin. (Deregistering the plugin's
+        # backend factory instead would remove 'tpu' from jax's known platforms and
+        # break pallas/checkify lowering registration at import time.)
         jax.config.update("jax_platforms", "cpu")
-        if not _xb.backends_are_initialized():
-            for _name in list(_xb._backend_factories):
-                if _name != "cpu":
-                    _xb._backend_factories.pop(_name, None)
     except Exception:  # noqa: BLE001 - best effort; env vars above still apply
         pass
 
