@@ -70,6 +70,10 @@ def _install_device_watchdog():
 def run_bench():
     ready = _install_device_watchdog()
 
+    from __graft_entry__ import _honor_cpu_request
+
+    _honor_cpu_request()
+
     import jax
 
     jax.devices()  # forces backend init — the step that hangs when the tunnel is down
