@@ -1,0 +1,121 @@
+"""GPT decoder tests: cached generation exactness, trainability, sampling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from unionml_tpu.models.gpt import (
+    GPTConfig,
+    GPTLMHeadModel,
+    generate,
+    init_params,
+    lm_loss,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = GPTConfig.tiny(dtype=jnp.float32, dropout=0.0, attention_impl="xla")
+    model = GPTLMHeadModel(cfg)
+    variables = init_params(cfg, seq_len=16)
+    return cfg, model, variables
+
+
+def test_forward_shapes(tiny):
+    cfg, model, variables = tiny
+    logits = model.apply(variables, jnp.ones((2, 8), dtype=jnp.int32), deterministic=True)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_cached_generation_matches_full_recompute(tiny):
+    cfg, model, variables = tiny
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 5)), dtype=jnp.int32)
+
+    ids = prompt
+    for _ in range(6):
+        logits = model.apply(variables, ids, deterministic=True)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+
+    out = generate(model, variables, prompt, max_new_tokens=6, max_len=16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
+
+    jitted = jax.jit(lambda p: generate(model, variables, p, max_new_tokens=6, max_len=16))
+    np.testing.assert_array_equal(np.asarray(jitted(prompt)), np.asarray(ids))
+
+
+def test_temperature_sampling_stays_in_vocab(tiny):
+    cfg, model, variables = tiny
+    prompt = jnp.ones((1, 3), dtype=jnp.int32)
+    out = generate(
+        model, variables, prompt, max_new_tokens=5, temperature=1.0, rng=jax.random.PRNGKey(7), max_len=16
+    )
+    assert out.shape == (1, 8)
+    assert bool(jnp.all((out >= 0) & (out < cfg.vocab_size)))
+
+
+def test_lm_training_reduces_loss(tiny):
+    cfg, model, variables = tiny
+    rng = np.random.default_rng(1)
+    # a memorizable repeating sequence
+    ids = jnp.asarray(np.tile(rng.integers(0, cfg.vocab_size, size=(1, 4)), (4, 4)), dtype=jnp.int32)
+    params = variables["params"]
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, ids, deterministic=True)
+            return lm_loss(logits, ids)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(25):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+
+def test_lm_loss_masks_padding(tiny):
+    cfg, model, variables = tiny
+    ids = jnp.asarray([[5, 6, 7, 0, 0]], dtype=jnp.int32)
+    mask = jnp.asarray([[1, 1, 1, 0, 0]], dtype=jnp.int32)
+    logits = model.apply(variables, ids, deterministic=True)
+    masked = lm_loss(logits, ids, mask)
+    unmasked_prefix = lm_loss(logits[:, :3], ids[:, :3])
+    np.testing.assert_allclose(float(masked), float(unmasked_prefix), rtol=1e-5)
+
+
+def test_generate_rejects_out_of_range_lengths(tiny):
+    cfg, model, variables = tiny
+    prompt = jnp.ones((1, 5), dtype=jnp.int32)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        generate(model, variables, prompt, max_new_tokens=6, max_len=8)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        generate(model, variables, prompt, max_new_tokens=cfg.max_position_embeddings + 10)
+
+
+def test_cache_dtype_follows_config():
+    from unionml_tpu.models.gpt import init_cache
+
+    bf16_cfg = GPTConfig.tiny()  # default bfloat16 compute
+    cache = init_cache(bf16_cfg, batch=1, max_len=8)
+    assert cache["layer_0"]["k"].dtype == jnp.bfloat16
+    f32_cache = init_cache(bf16_cfg, batch=1, max_len=8, dtype=jnp.float32)
+    assert f32_cache["layer_0"]["k"].dtype == jnp.float32
+
+
+def test_package_level_gpt_initializer():
+    from unionml_tpu.models import init_gpt_params
+
+    cfg = GPTConfig.tiny(dtype=jnp.float32)
+    variables = init_gpt_params(cfg, seq_len=8)
+    assert "wte" in variables["params"]

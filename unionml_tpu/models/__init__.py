@@ -13,6 +13,14 @@ from unionml_tpu.models.bert import (
     init_params,
     param_shardings,
 )
+from unionml_tpu.models.gpt import (
+    GPTConfig,
+    GPTLMHeadModel,
+    generate,
+    init_cache,
+    lm_loss,
+)
+from unionml_tpu.models.gpt import init_params as init_gpt_params
 from unionml_tpu.models.mlp import CNNClassifier, MLPClassifier
 from unionml_tpu.models.training import (
     FitResult,
@@ -30,7 +38,13 @@ __all__ = [
     "BertModel",
     "CNNClassifier",
     "FitResult",
+    "GPTConfig",
+    "GPTLMHeadModel",
     "MLPClassifier",
+    "generate",
+    "init_cache",
+    "init_gpt_params",
+    "lm_loss",
     "TrainState",
     "create_train_state",
     "dict_batches",
