@@ -113,9 +113,19 @@ def test_cache_dtype_follows_config():
     assert f32_cache["layer_0"]["k"].dtype == jnp.float32
 
 
-def test_package_level_gpt_initializer():
-    from unionml_tpu.models import init_gpt_params
+def test_package_level_gpt_exports():
+    from unionml_tpu.models import gpt_generate, gpt_lm_loss, init_gpt_cache, init_gpt_params
 
     cfg = GPTConfig.tiny(dtype=jnp.float32)
     variables = init_gpt_params(cfg, seq_len=8)
     assert "wte" in variables["params"]
+    assert gpt_generate is generate and gpt_lm_loss is lm_loss
+
+
+def test_logits_are_f32_under_bf16_config():
+    """The tied head must emit genuine f32 logits even with bf16 compute."""
+    cfg = GPTConfig.tiny(dropout=0.0)  # default bfloat16
+    model = GPTLMHeadModel(cfg)
+    variables = init_params(cfg, seq_len=8)
+    logits = model.apply(variables, jnp.ones((1, 8), dtype=jnp.int32), deterministic=True)
+    assert logits.dtype == jnp.float32

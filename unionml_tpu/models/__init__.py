@@ -13,14 +13,14 @@ from unionml_tpu.models.bert import (
     init_params,
     param_shardings,
 )
-from unionml_tpu.models.gpt import (
-    GPTConfig,
-    GPTLMHeadModel,
-    generate,
-    init_cache,
-    lm_loss,
-)
+# GPT helpers export under gpt-prefixed names: bare `generate`/`lm_loss` would
+# collide with future decoder families the way init_params already collided with
+# BERT's. Module-qualified access (models.gpt.generate) remains canonical.
+from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from unionml_tpu.models.gpt import generate as gpt_generate
+from unionml_tpu.models.gpt import init_cache as init_gpt_cache
 from unionml_tpu.models.gpt import init_params as init_gpt_params
+from unionml_tpu.models.gpt import lm_loss as gpt_lm_loss
 from unionml_tpu.models.mlp import CNNClassifier, MLPClassifier
 from unionml_tpu.models.training import (
     FitResult,
@@ -41,10 +41,10 @@ __all__ = [
     "GPTConfig",
     "GPTLMHeadModel",
     "MLPClassifier",
-    "generate",
-    "init_cache",
+    "gpt_generate",
+    "gpt_lm_loss",
+    "init_gpt_cache",
     "init_gpt_params",
-    "lm_loss",
     "TrainState",
     "create_train_state",
     "dict_batches",

@@ -4,7 +4,8 @@ Completes the model-family coverage next to the BERT encoder: pre-LN transformer
 decoder blocks over the framework's causal flash attention for training, and an
 explicit functional KV cache for O(1)-per-token greedy/temperature decoding under
 ``lax.scan`` (static shapes; the cache is a pytree argument, not module state, so the
-whole generate loop jit-compiles).
+whole generate loop jit-compiles). Prefill is chunked: one forward over the whole
+prompt fills every layer's cache before the decode scan starts.
 
 TPU-first choices: bfloat16 compute / f32 params, rotary-free learned positions (the
 GPT-2 recipe), logits in f32, weight tying between embedding and LM head.
@@ -69,12 +70,22 @@ class DecoderBlock(nn.Module):
             context = attention(q, k, v, causal=True, impl=cfg.attention_impl)
             new_cache = None
         else:
-            # write the new K/V at `position`, attend over the valid prefix
+            # write the new K/V block at `position`; works for single-token decode
+            # (seq=1) AND chunked prefill (seq=prompt_len, position=0)
             k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, 0, position, 0))
             v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, 0, position, 0))
-            kv_lens = jnp.full((batch,), position + 1, dtype=jnp.int32)
-            mask = (jnp.arange(k_cache.shape[2])[None, :] < kv_lens[:, None])[:, None, None, :]
-            context = xla_attention(q, k_cache, v_cache, mask=mask)
+            if seq > 1 and isinstance(position, int) and position == 0:
+                # start-of-sequence prefill: no earlier keys exist, so plain causal
+                # attention over the chunk (the flash kernel on TPU) is exact — no
+                # dense mask, no scoring against empty cache slots
+                context = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            else:
+                # decode step / mid-sequence chunk: attend over the cache with a
+                # global-position causal mask
+                q_pos = position + jnp.arange(seq)
+                k_pos = jnp.arange(k_cache.shape[2])
+                mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+                context = xla_attention(q, k_cache, v_cache, mask=mask)
             new_cache = {"k": k_cache, "v": v_cache}
 
         context = context.transpose(0, 2, 1, 3).reshape(batch, seq, cfg.hidden_size)
@@ -109,7 +120,7 @@ class GPTLMHeadModel(nn.Module):
         if cache is None:
             positions = jnp.arange(seq)[None, :]
         else:
-            positions = jnp.full((batch, seq), position, dtype=jnp.int32)
+            positions = (position + jnp.arange(seq))[None, :].astype(jnp.int32)
         hidden = embed(input_ids) + nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype, name="wpe"
         )(positions)
@@ -125,7 +136,13 @@ class GPTLMHeadModel(nn.Module):
                 new_cache[f"layer_{i}"] = layer_cache
 
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="final_norm")(hidden)
-        logits = embed.attend(hidden.astype(jnp.float32))  # tied head, f32 logits
+        # tied head with genuinely-f32 logits: Embed.attend would promote back to the
+        # compute dtype (bf16), costing mantissa over a large vocab
+        logits = jnp.dot(
+            hidden.astype(jnp.float32),
+            embed.embedding.astype(jnp.float32).T,
+            preferred_element_type=jnp.float32,
+        )
         return (logits, new_cache) if cache is not None else logits
 
 
@@ -178,18 +195,9 @@ def generate(
 
     cache = init_cache(config, batch, max_len)
 
-    # prefill: feed the prompt token by token (simple + shape-static; a chunked
-    # prefill using the causal kernel is the queued optimization)
-    def prefill_step(carry, t):
-        cache, _ = carry
-        logits, cache = model.apply(
-            variables, jax.lax.dynamic_slice(prompt_ids, (0, t), (batch, 1)), cache=cache, position=t
-        )
-        return (cache, logits[:, -1, :]), None
-
-    (cache, last_logits), _ = jax.lax.scan(
-        prefill_step, (cache, jnp.zeros((batch, config.vocab_size), jnp.float32)), jnp.arange(prompt_len)
-    )
+    # chunked prefill: one forward over the whole prompt fills every layer's cache
+    logits, cache = model.apply(variables, prompt_ids, cache=cache, position=0)
+    last_logits = logits[:, -1, :]
 
     def sample(logits, key):
         if temperature <= 0.0:
