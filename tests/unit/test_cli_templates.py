@@ -15,7 +15,7 @@ from unionml_tpu.templates import list_templates, render_template
 def test_list_templates():
     assert set(list_templates()) >= {
         "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
-        "serverless", "torch-digits", "keras-mnist",
+        "serverless", "torch-digits", "keras-mnist", "gpt-textgen",
     }
 
 
@@ -23,7 +23,7 @@ def test_list_templates():
     "template",
     [
         "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
-        "serverless", "torch-digits", "keras-mnist",
+        "serverless", "torch-digits", "keras-mnist", "gpt-textgen",
     ],
 )
 def test_render_template_compiles(template, tmp_path):

@@ -21,6 +21,7 @@ _DESCRIPTIONS = {
     "serverless": "digits classifier behind a FaaS event handler",
     "torch-digits": "pytorch MLP digits classifier (opaque-trainer path)",
     "keras-mnist": "Keras MNIST CNN (the reference tutorial recipe, opaque path)",
+    "gpt-textgen": "character-level GPT text generation with KV-cache decoding",
 }
 
 
