@@ -229,3 +229,63 @@ def test_pipeline_and_moe_are_trainable():
     np.testing.assert_allclose(
         np.asarray(jax.grad(loss_ep)(eW)), np.asarray(jax.grad(loss_ep_ref)(eW)), atol=1e-5
     )
+
+
+def test_moe_capacity_no_drop_matches_dense():
+    """GShard capacity dispatch equals gate-weighted per-token expert outputs."""
+    from unionml_tpu.parallel.ep import moe_apply_capacity
+
+    rng = np.random.default_rng(0)
+    mesh = make_mesh({"data": 2, "expert": 4})
+    E, D, T = 8, 16, 64
+    eW = jnp.asarray(rng.normal(size=(E, D, 12)) * 0.3, dtype=jnp.float32)
+    tokens = jnp.asarray(rng.normal(size=(T, D)), dtype=jnp.float32)
+    gates = jax.nn.softmax(jnp.asarray(rng.normal(size=(T, E)), dtype=jnp.float32), axis=-1)
+
+    out = jax.jit(
+        lambda eW, tokens, gates: moe_apply_capacity(
+            lambda W, t: t @ W, eW, tokens, gates, mesh, capacity_factor=8.0
+        )
+    )(eW, tokens, gates)
+
+    idx = jnp.argmax(gates, axis=-1)
+    gval = jnp.take_along_axis(gates, idx[:, None], axis=-1)[:, 0]
+    ref = jnp.stack([gval[i] * (tokens[i] @ eW[idx[i]]) for i in range(T)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    from unionml_tpu.parallel.ep import moe_apply_capacity
+
+    rng = np.random.default_rng(1)
+    mesh = make_mesh({"data": 2, "expert": 4})
+    E, D, T = 8, 8, 32
+    eW = jnp.asarray(rng.normal(size=(E, D, D)) * 0.3, dtype=jnp.float32)
+    tokens = jnp.asarray(rng.normal(size=(T, D)), dtype=jnp.float32)
+    gates = jax.nn.softmax(jnp.asarray(rng.normal(size=(T, E)), dtype=jnp.float32), axis=-1)
+
+    out = moe_apply_capacity(lambda W, t: t @ W, eW, tokens, gates, mesh, capacity_factor=E / T)
+    idx = np.asarray(jnp.argmax(gates, axis=-1))
+    seen = set()
+    for i in range(T):
+        if idx[i] in seen:
+            assert float(jnp.max(jnp.abs(out[i]))) == 0.0  # beyond capacity 1: dropped
+        else:
+            seen.add(idx[i])
+            assert float(jnp.max(jnp.abs(out[i]))) > 0.0
+
+
+def test_moe_capacity_validations_and_dtypes():
+    from unionml_tpu.parallel.ep import moe_apply_capacity
+
+    mesh = make_mesh({"data": 2, "expert": 4})
+    tokens = jnp.ones((8, 4), dtype=jnp.bfloat16)
+    gates = jax.nn.softmax(jnp.ones((8, 8)), axis=-1)  # f32 router, bf16 activations
+
+    out = moe_apply_capacity(lambda W, t: t @ W, jnp.ones((8, 4, 4), jnp.bfloat16), tokens, gates, mesh)
+    assert out.dtype == jnp.bfloat16  # moe_apply's output-dtype contract
+
+    with pytest.raises(ValueError, match="divisible"):
+        moe_apply_capacity(lambda W, t: t @ W, jnp.ones((6, 4, 4)), tokens, jnp.ones((8, 6)), mesh)
+    with pytest.raises(ValueError, match="stacked_params carries"):
+        moe_apply_capacity(lambda W, t: t @ W, jnp.ones((4, 4, 4)), tokens, gates, mesh)

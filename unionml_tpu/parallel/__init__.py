@@ -6,7 +6,7 @@ collectives — the TPU-native replacement for an NCCL/MPI backend (SURVEY.md §
 """
 
 from unionml_tpu.parallel.dp import batches, data_parallel_eval, data_parallel_step, pad_to_multiple
-from unionml_tpu.parallel.ep import expert_sharding, moe_apply
+from unionml_tpu.parallel.ep import expert_sharding, moe_apply, moe_apply_capacity
 from unionml_tpu.parallel.pp import pipeline_apply, stage_sharding
 from unionml_tpu.parallel.ring import ring_attention, sequence_sharding
 from unionml_tpu.parallel.ulysses import ulysses_attention
@@ -37,6 +37,7 @@ __all__ = [
     "expert_sharding",
     "logical_to_sharding",
     "moe_apply",
+    "moe_apply_capacity",
     "pipeline_apply",
     "stage_sharding",
     "make_hybrid_mesh",

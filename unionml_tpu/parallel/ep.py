@@ -13,6 +13,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -78,3 +79,69 @@ def moe_apply(
 def expert_sharding(mesh: Mesh, axis: str = EXPERT_AXIS) -> NamedSharding:
     """Sharding for stacked per-expert parameters (leading expert axis)."""
     return NamedSharding(mesh, P(axis))
+
+
+def moe_apply_capacity(
+    expert_fn: Callable,
+    stacked_params: Any,
+    tokens: jax.Array,
+    gates: jax.Array,
+    mesh: Mesh,
+    *,
+    capacity_factor: float = 1.25,
+    axis: str = EXPERT_AXIS,
+) -> jax.Array:
+    """GShard-style capacity-based top-1 MoE: sharding constraints, XLA collectives.
+
+    Unlike :func:`moe_apply` (dense-masked, every device computes all tokens), this
+    formulation dispatches each token into its expert's fixed-capacity buffer via
+    one-hot einsums; expert buffers carry an ``expert``-axis sharding constraint, so
+    under ``jit`` XLA inserts the all-to-alls that move only each expert's tokens to
+    its device. Tokens beyond an expert's capacity are DROPPED (output zero) — the
+    standard GShard trade-off; size ``capacity_factor`` accordingly.
+
+    :param gates: (tokens, num_experts) router probabilities (e.g. softmax output);
+        the top-1 expert's gate value scales its output (straight-through routing).
+    :returns: (tokens, d_out) combined expert outputs.
+    """
+    num_tokens, num_experts = gates.shape
+    axis_size = mesh.shape[axis]
+    params_experts = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if params_experts != num_experts:
+        raise ValueError(
+            f"gates are over {num_experts} experts but stacked_params carries {params_experts}"
+        )
+    if num_experts % axis_size:
+        raise ValueError(
+            f"num_experts ({num_experts}) must be divisible by the {axis!r} axis size ({axis_size})"
+        )
+    capacity = int(np.ceil(num_tokens / num_experts * capacity_factor))
+    capacity = max(capacity, 1)
+
+    expert_index = jnp.argmax(gates, axis=-1)  # (t,)
+    gate_value = jnp.take_along_axis(gates, expert_index[:, None], axis=-1)[:, 0]  # (t,)
+    # count buffer positions in int32: counting in a low-precision activation dtype
+    # (bf16) silently corrupts routing past 256 tokens per expert
+    expert_one_hot_i = jax.nn.one_hot(expert_index, num_experts, dtype=jnp.int32)  # (t, e)
+    position_in_expert = jnp.sum(
+        (jnp.cumsum(expert_one_hot_i, axis=0) - expert_one_hot_i) * expert_one_hot_i, axis=-1
+    )  # (t,)
+
+    # (t, e, c) dispatch tensor; one_hot zeroes out-of-range positions, which IS the
+    # capacity drop (tokens with position >= capacity get an all-zero row)
+    expert_one_hot = expert_one_hot_i.astype(tokens.dtype)
+    position_one_hot = jax.nn.one_hot(position_in_expert, capacity, dtype=tokens.dtype)  # (t, c)
+    dispatch = expert_one_hot[:, :, None] * position_one_hot[:, None, :]
+
+    expert_inputs = jnp.einsum("tec,td->ecd", dispatch, tokens)  # (e, c, d)
+    expert_inputs = jax.lax.with_sharding_constraint(
+        expert_inputs, NamedSharding(mesh, P(axis, None, None))
+    )
+    expert_outputs = jax.vmap(expert_fn)(stacked_params, expert_inputs)  # (e, c, d_out)
+    expert_outputs = jax.lax.with_sharding_constraint(
+        expert_outputs, NamedSharding(mesh, P(axis, None, None))
+    )
+
+    combine = dispatch * gate_value.astype(tokens.dtype)[:, None, None]
+    out = jnp.einsum("tec,ecd->td", combine, expert_outputs.astype(tokens.dtype))
+    return out.astype(tokens.dtype)  # keep moe_apply's output-dtype contract
