@@ -102,6 +102,10 @@ def test_serving_subprocess_health_and_predict(served_model):
     predictions = _post_predict(port, {"inputs": {"n": 7}})
     assert len(predictions) == 7
 
+    # ADVICE #4: present-but-empty inputs means "run the reader with defaults"
+    predictions = _post_predict(port, {"inputs": {}})
+    assert len(predictions) == 80
+
     with pytest.raises(urllib.error.HTTPError) as excinfo:
         _post_predict(port, {})
     assert excinfo.value.code == 500

@@ -88,9 +88,12 @@ def test_predict_requires_artifact(model):
         model.predict(sample_frac=1.0)
 
 
-def test_predict_requires_features_or_kwargs(trained_model):
-    with pytest.raises(ValueError, match="At least one of features"):
-        trained_model.predict()
+def test_predict_zero_args_runs_fully_defaulted_reader(trained_model):
+    # the fixture reader has all-default args, so a zero-arg predict is valid and
+    # runs the reader with defaults (ADVICE #4 semantics); readers with required
+    # args still raise — see test_advice_regressions.py
+    predictions = trained_model.predict()
+    assert len(predictions) == 100
 
 
 def test_saver_loader_path_and_fileobj(trained_model, tmp_path):

@@ -294,7 +294,16 @@ class Dataset(TrackedInstance):
         """
         if self._device_format == "jax":
             import jax
+            from typing import get_origin
 
+            if self._feature_loader != self._default_feature_loader:
+                # a custom loader returning a DICT defines a multi-input feature
+                # structure (tokenized models); device conversion preserves it.
+                # Loaders annotated with host-side types (DataFrame, lists) keep the
+                # jax.Array contract — conversion flattens them to a device array.
+                annotation = signature(self._feature_loader).return_annotation
+                if annotation is not Parameter.empty and get_origin(annotation) is dict:
+                    return annotation
             return jax.Array
         dataset_type = (
             self.dataset_datatype["data"]

@@ -134,20 +134,26 @@ class Model(TrackedInstance):
         argument > partially annotated signature (defaults fill types) > fully annotated
         signature.
         """
-        if self._hyperparameter_type is not None:
-            return self._hyperparameter_type
+        if self._hyperparameter_type is None:
+            self._hyperparameter_type = self._synthesize_hyperparameter_type(self._hyperparameter_config)
+        return self._hyperparameter_type
 
+    def _synthesize_hyperparameter_type(self, config: Optional[Dict[str, Any]]) -> Type:
+        """Pure derivation of the hyperparameter type from an explicit config or the init
+        signature — no instance state is read besides the init slots, none is written.
+        (Thread-safety: ``train``/``remote_train`` call this with an ad-hoc config instead
+        of temporarily mutating ``_hyperparameter_config``.)
+        """
         init_fn = self._init_callable if self._init == self._default_init else self._init
         init_fn = init_fn or self._init_callable
         sig_params = [] if init_fn is None else [*signature(init_fn).parameters.values()]
         # drop a leading `self`-like hyperparameters param when init is the default bound method
         specs: List[Any] = []
 
-        if self._hyperparameter_config is not None:
-            for hname, htype in self._hyperparameter_config.items():
+        if config is not None:
+            for hname, htype in config.items():
                 specs.append((hname, htype))
         elif len(sig_params) == 1 and sig_params[0].annotation is dict:
-            self._hyperparameter_type = dict
             return dict
         elif any(p.annotation is _EMPTY for p in sig_params):
             for param in sig_params:
@@ -164,8 +170,16 @@ class Model(TrackedInstance):
                 default = None if param.default is _EMPTY else param.default
                 specs.append((param.name, param.annotation, field(default=default)))
 
-        self._hyperparameter_type = make_json_dataclass("Hyperparameters", specs, bases=(BaseHyperparameters,))
-        return self._hyperparameter_type
+        return make_json_dataclass("Hyperparameters", specs, bases=(BaseHyperparameters,))
+
+    def _resolve_hyperparameter_type(self, hyperparameters: Any) -> Type:
+        """The type to wrap ``hyperparameters`` in for one call: the declared/synthesized
+        type when a config or annotated init exists, else a type inferred from the ad-hoc
+        dict — derived without mutating shared state (safe under concurrent train/serve).
+        """
+        if isinstance(hyperparameters, dict) and self._hyperparameter_config is None and hyperparameters:
+            return self._synthesize_hyperparameter_type({k: type(v) for k, v in hyperparameters.items()})
+        return self.hyperparameter_type
 
     @property
     def model_type(self) -> Optional[Type]:
@@ -684,13 +698,8 @@ class Model(TrackedInstance):
         trainer_kwargs = trainer_kwargs or {}
 
         # infer hyperparameter types from the provided dict when no config exists
-        override_config = isinstance(hyperparameters, dict) and self._hyperparameter_config is None
-        if override_config and hyperparameters:
-            self._hyperparameter_config = {k: type(v) for k, v in hyperparameters.items()}
-            self._hyperparameter_type = None
-            self._train_stage = None
-
-        hp_type = self.hyperparameter_type
+        # (pure derivation — no shared-state mutation, safe under concurrent calls)
+        hp_type = self._resolve_hyperparameter_type(hyperparameters)
         hp_value = hyperparameters if hp_type is dict else hp_type(**(hyperparameters or {}))
         model_obj, hyperparameters_out, metrics = self.train_workflow()(
             hyperparameters=hp_value if hp_value is not None else {},
@@ -700,17 +709,21 @@ class Model(TrackedInstance):
             **{**reader_kwargs, **trainer_kwargs},
         )
 
-        if override_config:
-            self._hyperparameter_config = None
-            self._hyperparameter_type = None
-
         self.artifact = ModelArtifact(model_obj, hyperparameters_out, metrics)
         return model_obj, metrics
 
     def predict(self, features: Any = None, **reader_kwargs):
         """Generate predictions locally (``model.py:711-741``)."""
         if features is None and not reader_kwargs:
-            raise ValueError("At least one of features or **reader_kwargs must be provided")
+            # a zero-arg call is valid when the reader itself needs no arguments
+            # (serving's {"inputs": {}} payload means "run the reader with defaults")
+            reader = getattr(self._dataset, "_reader", None)
+            reader_ok = reader is not None and all(
+                p.default is not _EMPTY or p.kind in (Parameter.VAR_KEYWORD, Parameter.VAR_POSITIONAL)
+                for p in signature(reader).parameters.values()
+            )
+            if not reader_ok:
+                raise ValueError("At least one of features or **reader_kwargs must be provided")
         if self.artifact is None:
             raise RuntimeError(
                 "ModelArtifact not found: train a model with .train() or load one before predicting."
@@ -914,12 +927,7 @@ class Model(TrackedInstance):
         """Run a training job on the backend (``model.py:1085-1158``)."""
         backend = self._require_backend()
 
-        override_config = isinstance(hyperparameters, dict) and self._hyperparameter_config is None
-        if override_config and hyperparameters:
-            self._hyperparameter_config = {k: type(v) for k, v in hyperparameters.items()}
-            self._hyperparameter_type = None
-
-        hp_type = self.hyperparameter_type
+        hp_type = self._resolve_hyperparameter_type(hyperparameters)
         hp_value = hyperparameters if hp_type is dict else hp_type(**(hyperparameters or {}))
         inputs = {
             "hyperparameters": hp_value if hp_value is not None else {},
@@ -929,10 +937,6 @@ class Model(TrackedInstance):
             **{**reader_kwargs, **(trainer_kwargs or {})},
         }
         execution = backend.execute(self, self.train_workflow_name, inputs=inputs, app_version=app_version)
-
-        if override_config:
-            self._hyperparameter_config = None
-            self._hyperparameter_type = None
 
         logger.info("Executing %s, execution name: %s", self.train_workflow_name, execution.id)
         if not wait:

@@ -20,7 +20,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from unionml_tpu._logging import logger
 from unionml_tpu.ops.losses import cross_entropy_and_accuracy
-from unionml_tpu.parallel.mesh import DATA_AXIS, batch_sharding, replicated
+from unionml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_axis_size,
+    batch_sharding,
+    replicated,
+    wrapped_row_indices,
+)
 
 
 class TrainState(train_state.TrainState):
@@ -146,8 +152,13 @@ def dict_batches(
     if end == 0:
         end = n_rows
     sharding = batch_sharding(mesh) if mesh is not None else None
+    axis_size = batch_axis_size(mesh) if mesh is not None else 1
     for start in range(0, end, batch_size):
         idx = indices[start : start + batch_size]
+        if sharding is not None:
+            wrap = wrapped_row_indices(len(idx), axis_size)
+            if wrap is not None:
+                idx = idx[wrap]
         batch = {k: v[idx] for k, v in host.items()}
         if sharding is not None:
             batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
@@ -188,11 +199,16 @@ def fit(
     def batch_iterator(epoch_rng):
         if prefetch_loader is not None:
             sharding = batch_sharding(mesh) if mesh is not None else None
+            axis = batch_axis_size(mesh) if mesh is not None else 1
             # copy=True (the default) hands over loader-independent arrays, which is
             # required here: device transfers are async and would otherwise race the
             # slot ring recycling
             for views in prefetch_loader.epoch(rng=epoch_rng):
                 if sharding is not None:
+                    n = len(next(iter(views.values())))
+                    wrap = wrapped_row_indices(n, axis)
+                    if wrap is not None:  # ragged tail batch: wrap real rows to fit the mesh
+                        views = {k: v[wrap] for k, v in views.items()}
                     yield {k: jax.device_put(v, sharding) for k, v in views.items()}
                 else:
                     yield views

@@ -18,7 +18,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from unionml_tpu.parallel.mesh import DATA_AXIS, batch_sharding, make_mesh, replicated
+from unionml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_axis_size,
+    batch_sharding,
+    make_mesh,
+    replicated,
+    wrapped_row_indices,
+)
 
 
 def data_parallel_step(
@@ -79,8 +86,15 @@ def batches(
     end = (n_rows // batch_size) * batch_size if drop_remainder else n_rows
     if end == 0:
         end = n_rows  # degenerate tiny datasets: yield one short batch
+    axis_size = batch_axis_size(mesh) if mesh is not None else 1
     for start in range(0, end, batch_size):
         batch_idx = indices[start : start + batch_size]
+        if mesh is not None:
+            # ragged final/degenerate batches must still divide the sharded axes;
+            # wrap real row indices to fill (see wrapped_row_indices)
+            wrap = wrapped_row_indices(len(batch_idx), axis_size)
+            if wrap is not None:
+                batch_idx = batch_idx[wrap]
         batch = tuple(a[batch_idx] for a in host_arrays)
         if mesh is not None:
             sharding = batch_sharding(mesh)

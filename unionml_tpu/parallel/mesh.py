@@ -125,6 +125,32 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(present if len(present) > 1 else (present[0] if present else None)))
 
 
+def batch_axis_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    """Total device count the leading (batch) dim is sharded over under
+    :func:`batch_sharding` — the data×fsdp product when both axes are present."""
+    axes = tuple(a for a in (axis, FSDP_AXIS) if a in mesh.axis_names) if axis == DATA_AXIS else (axis,)
+    size = 1
+    for a in axes:
+        if a in mesh.axis_names:
+            size *= int(mesh.shape[a])
+    return size
+
+
+def wrapped_row_indices(n_rows: int, multiple: int):
+    """Row indices that wrap-fill ``n_rows`` up to a multiple of ``multiple``.
+
+    Returns ``None`` when already aligned. The fill repeats REAL rows (wrap-around)
+    instead of fabricating zero rows, so a ragged batch rescued onto a mesh never
+    trains or evaluates on fake data — a few examples are just slightly overweighted.
+    Shared by every sharded-batch producer (``dp.batches``, ``dict_batches``, the
+    prefetch path in ``fit``) so the rescue semantics cannot drift apart.
+    """
+    if multiple <= 1 or n_rows % multiple == 0:
+        return None
+    target = ((n_rows // multiple) + 1) * multiple
+    return np.resize(np.arange(n_rows), target)
+
+
 def shard_batch(batch: Any, mesh: Mesh, axis: str = DATA_AXIS) -> Any:
     """Lay a host batch (pytree) onto the mesh, sharded along the leading dim."""
     sharding = batch_sharding(mesh, axis)

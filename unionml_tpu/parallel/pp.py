@@ -1,17 +1,32 @@
-"""Pipeline parallelism: GPipe-style microbatching over a ``"stage"`` mesh axis.
+"""Pipeline parallelism: microbatch pipelining over a ``"stage"`` mesh axis.
 
 Homogeneous-stage pipelining (the transformer-layers case): per-stage parameters are
 stacked on a leading axis and sharded over ``stage``; microbatches flow device-to-device
 via ``lax.ppermute`` (ICI neighbor exchange). The schedule runs
 ``num_microbatches + num_stages - 1`` ticks; at tick t, stage s computes microbatch
-``t - s`` (the classic GPipe fill/steady/drain). Each device COMPUTES on one
-microbatch per tick (compute O(batch/M) at a time); note that in this first version
-the input and output buffers are replicated across stages for schedule simplicity, so
-per-device BUFFER memory is O(batch) — stage-0-only feeding and per-tick collection
-are the queued optimization (NEXT.md).
+``t - s`` (classic fill/steady/drain).
+
+**Stage-local buffers** (round-2; round 1 replicated them O(batch) per device): the
+microbatch input buffer is SHARDED over the stage axis and left-rotates one slot per
+tick, so stage 0 always finds the next microbatch in its local slot 0 — per-device
+input memory is O(batch / num_stages). Outputs are collected symmetrically into a
+stage-sharded left-rotating buffer that lands microbatch j in global slot j on the
+final tick. Each rotation moves one microbatch over ICI and overlaps with the tick's
+stage compute under XLA's scheduler.
+
+**Backward** is the transpose of this schedule: differentiating the scan yields a
+reverse pipeline (``ppermute`` transposes to the opposite permutation), i.e. B runs
+after F per microbatch with the same bubble fraction — the GPipe-equivalent reverse
+schedule. A hand-interleaved 1F1B would need per-stage divergent control flow inside
+one SPMD program, which XLA lowers to select(both-branches) — ~1.5x the compute of the
+transposed schedule — so the TPU-idiomatic memory lever is rematerialization instead:
+``remat=True`` wraps the stage body in ``jax.checkpoint``, bounding saved activations
+to one microbatch input per tick (O(batch/num_microbatches) working set per stage)
+while the backward recomputes stage internals on the fly. (This is the same stance the
+public praxis/GSPMD pipelining layers take on TPU.)
 
 SURVEY.md §2 marks PP "future work" for the reference rebuild; here it lands as a
-composable primitive (the dryrun exercises it alongside dp/fsdp/tp/sp).
+composable primitive (the dryrun exercises it alongside dp/fsdp/tp/sp/ep).
 """
 
 import functools
@@ -25,39 +40,49 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 STAGE_AXIS = "stage"
 
 
-def _pipeline_local(stage_params, x_mb, *, stage_fn, axis_name: str, num_microbatches: int):
-    """Per-device schedule: consume at stage 0, compute own stage, pass rightward."""
+def _pipeline_local(stage_params, inp, *, stage_fn, axis_name: str, num_microbatches: int):
+    """Per-device schedule with stage-sharded rotating input/output buffers.
+
+    ``inp``: (K, mb, ...) — this device's shard of the (M, mb, ...) microbatch stack.
+    Per tick: stage 0 consumes its local slot 0 (the left-rotation below guarantees
+    global microbatch t sits there at tick t); every stage computes; the activation
+    hands off rightward; both buffers left-rotate one slot around the ring.
+    """
     num_stages = lax.psum(1, axis_name)
     stage_index = lax.axis_index(axis_name)
     stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)  # drop stage dim
 
-    mb_shape = x_mb.shape[1:]
-    outputs = jnp.zeros((num_microbatches,) + mb_shape, dtype=x_mb.dtype)
-    carry = jnp.zeros(mb_shape, dtype=x_mb.dtype)
-    perm = [(i, i + 1) for i in range(num_stages - 1)]
+    k_local = inp.shape[0]  # num_microbatches // num_stages
+    mb_shape = inp.shape[1:]
+    outputs = jnp.zeros((k_local,) + mb_shape, dtype=inp.dtype)
+    carry = jnp.zeros(mb_shape, dtype=inp.dtype)
+    handoff = [(i, i + 1) for i in range(num_stages - 1)]
+    rotate_left = [(i, (i - 1) % num_stages) for i in range(num_stages)]
+
+    def rotate(buf):
+        # global slot p -> p-1 (mod M): first local slot moves to the previous
+        # device's last slot; the rest shift down locally
+        recv = lax.ppermute(buf[0], axis_name, rotate_left)
+        return jnp.concatenate([buf[1:], recv[None]], axis=0)
 
     def tick(t, state):
-        outputs, carry = state
-        feed_index = jnp.clip(t, 0, num_microbatches - 1)
-        # stage 0 consumes a fresh microbatch; later stages consume the handoff
-        h_in = jnp.where(stage_index == 0, x_mb[feed_index], carry)
+        outputs, carry, inp = state
+        # stage 0 consumes the microbatch the rotation delivered to its slot 0
+        h_in = jnp.where(stage_index == 0, inp[0], carry)
         h_out = stage_fn(stage_params, h_in)
-        # collect at the last stage once the pipeline has filled (t >= num_stages - 1)
-        out_index = jnp.clip(t - (num_stages - 1), 0, num_microbatches - 1)
+        inp = rotate(inp)
+        # collect at the last stage once the pipeline has filled (t >= num_stages-1):
+        # rotate first, then write into the LAST global slot; the remaining
+        # M-1-j rotations walk microbatch j's output to global slot j
+        outputs = rotate(outputs)
         is_output_tick = jnp.logical_and(stage_index == num_stages - 1, t >= num_stages - 1)
-        outputs = jnp.where(
-            is_output_tick,
-            outputs.at[out_index].set(h_out),
-            outputs,
-        )
-        carry = lax.ppermute(h_out, axis_name, perm)
-        return outputs, carry
+        outputs = jnp.where(is_output_tick, outputs.at[k_local - 1].set(h_out), outputs)
+        carry = lax.ppermute(h_out, axis_name, handoff)
+        return outputs, carry, inp
 
     total_ticks = num_microbatches + num_stages - 1
-    outputs, _ = lax.fori_loop(0, total_ticks, tick, (outputs, carry))
-    # only the last stage holds real outputs; psum replicates them across the axis
-    outputs = jnp.where(stage_index == num_stages - 1, outputs, jnp.zeros_like(outputs))
-    return lax.psum(outputs, axis_name)
+    outputs, _, _ = lax.fori_loop(0, total_ticks, tick, (outputs, carry, inp), unroll=False)
+    return outputs
 
 
 def pipeline_apply(
@@ -68,25 +93,37 @@ def pipeline_apply(
     *,
     num_microbatches: int,
     axis: str = STAGE_AXIS,
+    remat: bool = False,
 ) -> jax.Array:
-    """Apply ``num_stages`` instances of ``stage_fn`` as a GPipe pipeline.
+    """Apply ``num_stages`` instances of ``stage_fn`` as a microbatch pipeline.
 
     :param stage_fn: ``(params, h) -> h`` with matching input/output shapes
         (homogeneous stages — the stacked-transformer-layers case).
     :param stacked_params: pytree whose leaves carry a leading ``num_stages`` axis,
         sharded over ``axis``.
-    :param x: (batch, ...) input; ``num_microbatches`` must evenly divide ``batch``.
+    :param x: (batch, ...) input; ``num_microbatches`` must evenly divide ``batch``,
+        and the ``axis`` mesh size must evenly divide ``num_microbatches`` (the
+        microbatch stack is sharded over the stage axis — O(batch/num_stages)
+        input memory per device instead of a replicated O(batch) buffer).
     :param num_microbatches: pipeline fill granularity; per-tick compute per stage
         scales with ``batch / num_microbatches`` while bubble fraction scales with
-        ``(num_stages - 1) / (num_microbatches + num_stages - 1)``. Input/output
-        buffers are currently replicated across stages (O(batch) buffer memory).
-    :returns: (batch, ...) output, replicated over the stage axis.
+        ``(num_stages - 1) / (num_microbatches + num_stages - 1)``.
+    :param remat: rematerialize stage bodies in the backward pass
+        (``jax.checkpoint``) — saved residuals shrink to the per-tick microbatch
+        inputs; stage internals recompute during the reverse schedule.
+    :returns: (batch, ...) output, microbatch-sharded over the stage axis.
     """
     num_stages = mesh.shape[axis]
     batch = x.shape[0]
     if batch % num_microbatches:
         raise ValueError(
             f"num_microbatches ({num_microbatches}) must evenly divide batch ({batch})"
+        )
+    if num_microbatches % num_stages:
+        raise ValueError(
+            f"the {axis!r} mesh axis size ({num_stages}) must evenly divide "
+            f"num_microbatches ({num_microbatches}) — the microbatch stack is sharded "
+            f"over the stage axis"
         )
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] != num_stages:
@@ -97,15 +134,16 @@ def pipeline_apply(
 
     x_mb = x.reshape((num_microbatches, batch // num_microbatches) + x.shape[1:])
 
+    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
     params_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     body = functools.partial(
-        _pipeline_local, stage_fn=stage_fn, axis_name=axis, num_microbatches=num_microbatches
+        _pipeline_local, stage_fn=body_fn, axis_name=axis, num_microbatches=num_microbatches
     )
     out_mb = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(params_spec, P()),
-        out_specs=P(),
+        in_specs=(params_spec, P(axis)),
+        out_specs=P(axis),
         check_vma=False,
     )(stacked_params, x_mb)
     return out_mb.reshape((batch,) + x.shape[1:])

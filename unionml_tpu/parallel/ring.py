@@ -46,7 +46,11 @@ def _local_block_attention(
     block_max = jnp.max(scores, axis=-1, keepdims=True)
     new_max = jnp.maximum(row_max, block_max)
     correction = jnp.exp(row_max - new_max)
-    probs = jnp.exp(scores - new_max)
+    # rows where every score seen so far is masked keep new_max == _NEG_INF;
+    # exp(scores - new_max) would then be exp(0) == 1 and the accumulator would
+    # absorb garbage V sums, so such rows must contribute zero probability mass
+    # (they stay zero until a valid block arrives — fully-padded rows emit zeros).
+    probs = jnp.where(new_max > _NEG_INF / 2, jnp.exp(scores - new_max), 0.0)
     acc = acc * correction + jnp.einsum(
         "bhqk,bhkd->bhqd", probs, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
     )

@@ -72,8 +72,10 @@ def _parse_field(spec: str, lo: int, hi: int, names: Optional[dict] = None) -> S
     values: Set[int] = set()
     for part in spec.split(","):
         step = 1
+        has_step = False
         if "/" in part:
             part, step_s = part.split("/", 1)
+            has_step = True
             try:
                 step = int(step_s)
             except ValueError as exc:
@@ -97,6 +99,10 @@ def _parse_field(spec: str, lo: int, hi: int, names: Optional[dict] = None) -> S
                 start = end = int(part)
             except ValueError as exc:
                 raise ScheduleError(f"Invalid cron value {part!r}") from exc
+            if has_step:
+                # standard cron/croniter semantics: 'N/step' means the range N-hi
+                # stepped, e.g. minute '5/15' fires at 5,20,35,50 — not just 5
+                end = hi
         if not (lo <= start <= hi and lo <= end <= hi and start <= end):
             raise ScheduleError(f"Cron value {part!r} out of range [{lo}, {hi}]")
         values.update(range(start, end + 1, step))

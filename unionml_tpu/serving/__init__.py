@@ -13,16 +13,24 @@ def serving_app(
     app_version: Optional[str] = None,
     model_version: str = "latest",
     resident: bool = True,
+    **serving_kwargs: Any,
 ):
     """Build or extend a serving app for a model (``unionml/fastapi.py:15`` analogue).
 
-    - ``app=None``: returns the framework's native aiohttp application.
+    - ``app=None``: returns the framework's native aiohttp application. Extra kwargs
+      (``buckets``, ``seq_buckets``, ``example_features``, ``coalesce``, ...) flow to
+      :func:`build_aiohttp_app`.
     - ``app`` is a FastAPI instance (when fastapi is installed): endpoints are attached
       in place, reference-compatible.
     """
     if app is None:
         return build_aiohttp_app(
-            model, remote=remote, app_version=app_version, model_version=model_version, resident=resident
+            model,
+            remote=remote,
+            app_version=app_version,
+            model_version=model_version,
+            resident=resident,
+            **serving_kwargs,
         )
     try:
         from fastapi import FastAPI
@@ -32,7 +40,13 @@ def serving_app(
         from unionml_tpu.serving.fastapi_adapter import attach_fastapi
 
         return attach_fastapi(
-            model, app, remote=remote, app_version=app_version, model_version=model_version, resident=resident
+            model,
+            app,
+            remote=remote,
+            app_version=app_version,
+            model_version=model_version,
+            resident=resident,
+            **serving_kwargs,
         )
     raise TypeError(
         f"Unsupported app type {type(app)!r}: pass None for the native app or a fastapi.FastAPI instance."

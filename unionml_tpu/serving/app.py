@@ -84,17 +84,35 @@ def build_aiohttp_app(
     coalesce: bool = True,
     max_batch: int = 64,
     max_wait_ms: float = 2.0,
+    buckets: Optional[Any] = None,
+    seq_buckets: Optional[Any] = None,
+    example_features: Optional[Any] = None,
 ):
     """Create the aiohttp application with a resident predictor.
 
     ``coalesce=True`` merges concurrent row-list ``features`` requests into shared
     predictor calls (see :mod:`unionml_tpu.serving.batcher`); requests whose payloads
     don't fit the row-list contract fall back to per-request prediction.
+
+    ``seq_buckets`` enables sequence-length bucketing for tokenized inputs, and
+    ``example_features`` (a request-shaped row list) drives startup warmup for
+    multi-input models — see :class:`ResidentPredictor`.
     """
     from aiohttp import web
 
+    from unionml_tpu.serving.resident import DEFAULT_BUCKETS
+
     app = web.Application()
-    predictor = ResidentPredictor(model) if resident else None
+    predictor = (
+        ResidentPredictor(
+            model,
+            buckets=buckets or DEFAULT_BUCKETS,
+            seq_buckets=seq_buckets,
+            example_features=example_features,
+        )
+        if resident
+        else None
+    )
     batcher = None
     if coalesce and predictor is not None:
         from unionml_tpu.serving.batcher import RequestBatcher
@@ -137,7 +155,7 @@ def build_aiohttp_app(
 
         loop = asyncio.get_running_loop()
         try:
-            if inputs:
+            if inputs is not None:
                 # off the event loop: compiled predictor calls block for milliseconds+
                 result = await loop.run_in_executor(
                     None,

@@ -11,6 +11,7 @@ from typing import Any, Dict, List, Optional, Union
 from fastapi import Body, FastAPI, HTTPException
 from fastapi.responses import HTMLResponse
 
+from unionml_tpu._logging import logger
 from unionml_tpu.serving.app import _INDEX_HTML, jsonable, load_model_artifact
 from unionml_tpu.serving.resident import ResidentPredictor
 
@@ -22,8 +23,30 @@ def attach_fastapi(
     app_version: Optional[str] = None,
     model_version: str = "latest",
     resident: bool = True,
+    buckets: Optional[Any] = None,
+    seq_buckets: Optional[Any] = None,
+    example_features: Optional[Any] = None,
+    **unsupported: Any,
 ) -> FastAPI:
-    predictor = ResidentPredictor(model) if resident else None
+    from unionml_tpu.serving.resident import DEFAULT_BUCKETS
+
+    if unsupported:
+        # the aiohttp app supports more options (request coalescing); say so instead
+        # of silently ignoring them on this path
+        logger.warning(
+            "attach_fastapi ignoring unsupported serving options: %s", sorted(unsupported)
+        )
+
+    predictor = (
+        ResidentPredictor(
+            model,
+            buckets=buckets or DEFAULT_BUCKETS,
+            seq_buckets=seq_buckets,
+            example_features=example_features,
+        )
+        if resident
+        else None
+    )
 
     @app.on_event("startup")
     async def setup_model():
@@ -42,7 +65,7 @@ def attach_fastapi(
     ):
         if inputs is None and features is None:
             raise HTTPException(status_code=500, detail="inputs or features must be supplied.")
-        if inputs:
+        if inputs is not None:  # empty {} means "run the reader with defaults" (matches app.py)
             result = predictor.predict(**inputs) if predictor is not None else model.predict(**inputs)
         else:
             # model.predict runs the feature pipeline itself; don't pre-process here

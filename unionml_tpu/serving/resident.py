@@ -7,8 +7,22 @@ of padded batch shapes ("bucketing"), so the request path is: host->device trans
 run resident executable, device->host — the p50-latency metric in BASELINE.md.
 
 Dynamic request sizes vs XLA static shapes (SURVEY.md §7 "hard parts"): request batches
-pad up to the nearest bucket; predictions slice back down. Opaque model objects
-(sklearn/torch) bypass compilation and run eagerly — same endpoint, same semantics.
+pad up to the nearest bucket; predictions slice back down. Two bucketing axes:
+
+- **batch** (dim 0, always on): requests pad up the ``buckets`` ladder.
+- **sequence** (dim 1, opt-in via ``seq_buckets``): tokenized inputs (BERT-style
+  ``input_ids``/``attention_mask`` dicts) pad their sequence dimension up a second
+  ladder, so a 37-token request reuses the 64-token executable instead of compiling
+  a fresh shape per length.
+
+Features may be a single array OR a dict/pytree of arrays sharing a leading batch dim
+(multi-input models). Opaque model objects (sklearn/torch) bypass compilation and run
+eagerly — same endpoint, same semantics.
+
+Warmup sources, in priority order: an explicit ``example_features`` request payload
+(rows exactly as a client would POST them — covers tokenized/multi-input models), else
+the dataset's flat feature metadata. Pass ``example_features`` through
+``model.serve(example_features=[...])``.
 """
 
 from typing import Any, Optional, Sequence, Tuple
@@ -22,6 +36,15 @@ from unionml_tpu.stage import is_jax_compatible
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
+def _ladder_value(ladder: Tuple[int, ...], n: int) -> int:
+    """Smallest ladder entry >= n; oversize rounds up to a multiple of the largest."""
+    for rung in ladder:
+        if rung >= n:
+            return rung
+    largest = ladder[-1]
+    return ((n + largest - 1) // largest) * largest
+
+
 class ResidentPredictor:
     """Holds a model artifact on-device with a compiled predict executable."""
 
@@ -30,9 +53,13 @@ class ResidentPredictor:
         model: Any,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         warmup: bool = True,
+        seq_buckets: Optional[Sequence[int]] = None,
+        example_features: Optional[Any] = None,
     ):
         self._model = model
         self._buckets = tuple(sorted(buckets))
+        self._seq_buckets = tuple(sorted(seq_buckets)) if seq_buckets else None
+        self._example_features = example_features
         self._warmup = warmup
         self._compiled = None
         self._device_model_object = None
@@ -60,8 +87,11 @@ class ResidentPredictor:
     def _warm(self) -> None:
         """Compile the smallest bucket ahead of the first request."""
         try:
-            example = self._example_features(self._buckets[0])
+            example = self._example_processed(self._buckets[0])
             if example is None:
+                logger.info(
+                    "No warmup template (pass example_features to serve()); first request will compile."
+                )
                 return
             jax.block_until_ready(self._compiled(self._device_model_object, example))
             logger.info("Resident predictor warmed (bucket=%d).", self._buckets[0])
@@ -70,20 +100,76 @@ class ResidentPredictor:
             # wrong dtype/shape for this model; the first real request still compiles
             logger.info("Warmup skipped (%s: %s); first request will compile.", type(exc).__name__, exc)
 
-    def _example_features(self, batch: int) -> Optional[Any]:
-        """Synthesize zero features of bucket shape from the dataset's feature metadata."""
-        n_features = getattr(self._model.dataset, "_features", None)
-        if n_features:
-            return jax.numpy.zeros((batch, len(n_features)), dtype=jax.numpy.float32)
+    def _example_processed(self, batch: int) -> Optional[Any]:
+        """A processed, bucket-shaped feature pytree for warmup compilation.
+
+        Priority: run the user-supplied ``example_features`` request rows through the
+        real feature pipeline and pad them exactly like a live request (covers
+        multi-input/tokenized models), else synthesize zero features from flat
+        feature-column metadata.
+        """
+        if self._example_features is not None:
+            example = self._example_features
+            if isinstance(example, list) and example:
+                # resize the example rows to the requested bucket so warmup compiles
+                # the executable real requests will actually hit (smallest bucket)
+                example = [example[i % len(example)] for i in range(batch)]
+            processed = self._model.dataset.get_features(example)
+            padded, _, _ = self._pad_to_buckets(processed)
+            return padded
+        feature_columns = getattr(self._model.dataset, "_features", None)
+        if feature_columns:
+            return jax.numpy.zeros((batch, len(feature_columns)), dtype=jax.numpy.float32)
         return None
 
     def _bucket_for(self, n: int) -> int:
-        for bucket in self._buckets:
-            if bucket >= n:
-                return bucket
-        # oversize requests round up to a multiple of the largest bucket
-        largest = self._buckets[-1]
-        return ((n + largest - 1) // largest) * largest
+        return _ladder_value(self._buckets, n)
+
+    # ------------------------------------------------------------------ padding
+
+    def _array_leaves(self, processed: Any):
+        """Flatten processed features; returns (leaves, treedef) or None if any leaf
+        is not a batch-dim array (opaque features run eagerly)."""
+        leaves, treedef = jax.tree_util.tree_flatten(processed)
+        if not leaves:
+            return None
+        arrays = []
+        for leaf in leaves:
+            if not is_jax_compatible(leaf) or not hasattr(leaf, "shape") or getattr(leaf, "ndim", 0) < 1:
+                return None
+            arrays.append(leaf)
+        n = arrays[0].shape[0]
+        if any(a.shape[0] != n for a in arrays):
+            return None
+        return arrays, treedef, n
+
+    def _pad_to_buckets(self, processed: Any):
+        """Pad every array leaf's batch dim (and sequence dim, when configured) up the
+        bucket ladders. Returns (padded_pytree, original_batch, batch_bucket)."""
+        flat = self._array_leaves(processed)
+        if flat is None:
+            raise ValueError("features are not a batch-dim array pytree")
+        arrays, treedef, n = flat
+        bucket = self._bucket_for(n)
+        padded = []
+        for a in arrays:
+            a = np.asarray(a) if not isinstance(a, jax.Array) else a
+            if a.dtype == np.float64:
+                a = a.astype(np.float32)
+            pad = [(0, 0)] * a.ndim
+            if bucket != n:
+                pad[0] = (0, bucket - n)
+            if self._seq_buckets is not None and a.ndim >= 2:
+                seq = a.shape[1]
+                seq_bucket = _ladder_value(self._seq_buckets, seq)
+                if seq_bucket != seq:
+                    pad[1] = (0, seq_bucket - seq)
+            if any(p != (0, 0) for p in pad):
+                a = np.pad(np.asarray(a), pad)
+            padded.append(jax.numpy.asarray(a))
+        return jax.tree_util.tree_unflatten(treedef, padded), n, bucket
+
+    # ------------------------------------------------------------------ request path
 
     def predict(self, features: Any = None, **reader_kwargs) -> Any:
         """Request-path prediction; uses the resident executable when possible."""
@@ -93,19 +179,13 @@ class ResidentPredictor:
             return self._model.predict(features=features, **reader_kwargs)
 
         processed = self._model.dataset.get_features(features)
-        if not is_jax_compatible(processed) or not hasattr(processed, "shape"):
+        try:
+            padded, n, bucket = self._pad_to_buckets(processed)
+        except ValueError:
             return self._model.predict(features=features, **reader_kwargs)
 
-        array = np.asarray(processed) if not isinstance(processed, jax.Array) else processed
-        if array.dtype == np.float64:
-            array = array.astype(np.float32)
-        n = array.shape[0]
-        bucket = self._bucket_for(n)
-        if bucket != n:
-            pad = [(0, bucket - n)] + [(0, 0)] * (array.ndim - 1)
-            array = np.pad(np.asarray(array), pad)
         try:
-            predictions = self._compiled(self._device_model_object, jax.numpy.asarray(array))
+            predictions = self._compiled(self._device_model_object, padded)
         except Exception as exc:
             logger.info("Resident predict failed (%s); falling back to eager predict.", exc)
             self._compiled = None

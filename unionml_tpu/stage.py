@@ -22,7 +22,7 @@ import time
 from collections import OrderedDict
 from functools import wraps
 from pathlib import Path
-from typing import Any, Callable, Dict, FrozenSet, Mapping, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, FrozenSet, Mapping, Optional, Sequence, Set, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,11 @@ _EMPTY = inspect.Parameter.empty
 _TRACEABLE_LEAVES = (jax.Array, np.ndarray, np.generic, float, int, bool, complex)
 #: leaf types treated as static (compile-time constants) when auto-tracing
 _STATIC_LEAVES = (str, bytes, type(None))
+_TRACE_FAILED_KEYS_MAX = 128
+# trace-time failures (data-dependent control flow, tracer leaks, concretization —
+# all TypeError subclasses in jax.errors) are eligible for eager fallback; runtime
+# errors from compiled executables (JaxRuntimeError etc.) propagate instead
+_TRACE_FAILURES = (TypeError, jax.errors.UnexpectedTracerError)
 
 
 def is_jax_compatible(tree: Any) -> bool:
@@ -85,6 +90,7 @@ class TracedFunction:
         self._out_shardings = out_shardings
         self._eager = jit is False
         self._compiled: Dict[FrozenSet[str], Callable] = {}
+        self._trace_failed_keys: Set[Tuple] = set()
 
     @property
     def fn(self) -> Callable:
@@ -100,6 +106,35 @@ class TracedFunction:
             if isinstance(value, _STATIC_LEAVES) or not is_jax_compatible(value):
                 names.add(key)
         return tuple(sorted(names))
+
+    def _trace_key(self, static_names: Tuple[str, ...], args: Tuple, kwargs: Mapping[str, Any]) -> Tuple:
+        """Identity of one call's trace: static names AND values, plus the abstract
+        (shape/dtype/structure) signature of the traced arguments.
+
+        jax.jit retraces per static value and per abstract signature, so a failure
+        for one call must not disable compilation for calls jit would trace afresh
+        (a different static value, or different array shapes/dtypes). Unhashable
+        static values degrade to their type name. Only computed when a failure has
+        already been recorded (or is being recorded) — zero hot-path cost otherwise.
+        """
+        vals = []
+        for name in static_names:
+            if name in kwargs:
+                value = kwargs[name]
+                try:
+                    hash(value)
+                except TypeError:
+                    value = type(value).__name__
+                vals.append((name, value))
+        traced = {k: v for k, v in kwargs.items() if k not in static_names}
+        leaves, treedef = jax.tree_util.tree_flatten((args, traced))
+        abstract = tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+            else type(leaf).__name__
+            for leaf in leaves
+        )
+        return (static_names, tuple(vals), str(treedef), abstract)
 
     def _get_compiled(self, static_names: Tuple[str, ...]) -> Callable:
         key = frozenset(static_names)
@@ -127,18 +162,32 @@ class TracedFunction:
             return self._fn(*args, **kwargs)
 
         static_names = self._auto_static_names(kwargs)
+        if self._trace_failed_keys and self._trace_key(static_names, args, kwargs) in self._trace_failed_keys:
+            # this exact call signature failed to trace before; run it eagerly
+            # without downgrading other (traceable) call shapes on the instance
+            return self._fn(*args, **kwargs)
         try:
             return self._get_compiled(static_names)(*args, **kwargs)
         except Exception as exc:
-            if self._policy == "auto":
-                self._eager = True
+            if self._policy == "auto" and isinstance(exc, _TRACE_FAILURES):
+                if len(self._trace_failed_keys) >= _TRACE_FAILED_KEYS_MAX:
+                    # bound the blacklist: per-request static values (ids, dates)
+                    # would otherwise grow it for the process lifetime; clearing
+                    # just means an occasional re-attempted (failing) trace
+                    self._trace_failed_keys.clear()
+                self._trace_failed_keys.add(self._trace_key(static_names, args, kwargs))
                 logger.info(
-                    "%s: jit tracing failed (%s: %s); falling back to eager execution.",
+                    "%s: jit tracing failed (%s: %s); falling back to eager execution for this call signature.",
                     getattr(self._fn, "__name__", self._fn),
                     type(exc).__name__,
                     exc,
                 )
                 return self._fn(*args, **kwargs)
+            if self._policy == "auto":
+                # runtime failure of an already-compiled executable (or an error the
+                # user fn raised): propagate — masking it behind a permanent eager
+                # downgrade would hide real failures and lose the compiled hot path
+                raise
             raise StageError(f"jit compilation of {self._fn} failed") from exc
 
 

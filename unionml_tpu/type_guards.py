@@ -51,6 +51,16 @@ def types_compatible(actual: object, expected: object) -> bool:
         return True
     if _is_array_type(actual) and _is_array_type(expected):
         return True
+    # parameterized containers whose args differ only by array family are compatible:
+    # Dict[str, np.ndarray] features arrive as Dict[str, jax.Array] after the
+    # device-format conversion (tokenized multi-input models)
+    actual_origin, expected_origin = get_origin(actual), get_origin(expected)
+    if actual_origin is not None and actual_origin == expected_origin:
+        actual_args, expected_args = get_args(actual), get_args(expected)
+        if len(actual_args) == len(expected_args) and all(
+            types_compatible(a, e) for a, e in zip(actual_args, expected_args)
+        ):
+            return True
     return False
 
 

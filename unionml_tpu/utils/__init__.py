@@ -77,6 +77,10 @@ def to_device_arrays(*arrays: Any, dtype: Any = None) -> Tuple[jax.Array, ...]:
 
     out = []
     for array in arrays:
+        if isinstance(array, dict):
+            # multi-input features (tokenized models): convert each value, keep the dict
+            out.append({k: to_device_arrays(v, dtype=dtype)[0] for k, v in array.items()})
+            continue
         if hasattr(array, "to_numpy"):
             array = array.to_numpy()
         array = np.asarray(array)
