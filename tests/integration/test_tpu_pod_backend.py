@@ -1,0 +1,213 @@
+"""TPU pod backend: the LocalBackend contract against a store + transport boundary.
+
+VERDICT round-1 next-step #4: a real remote-execution target. These tests run the
+full deploy -> train -> fetch lifecycle through :class:`TPUPodBackend` with the
+transport faked at (and only at) the machine boundary (``LocalShellTransport``), the
+artifact store on fsspec (``file://`` so subprocesses share it), and — crucially —
+the app source delivered via the store's packaged zip, proven by deleting the
+original source file before executing.
+"""
+
+import shutil
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture()
+def pod_model(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYTHONPATH", str(REPO_ROOT))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path))
+    monkeypatch.chdir(REPO_ROOT)
+
+    from tests.integration.backend_app import model
+    from unionml_tpu.backend.tpu_pod import LocalShellTransport, TPUPodBackend
+
+    backend = TPUPodBackend(
+        store_url=f"file://{tmp_path}/store",
+        transport=LocalShellTransport(host_count=1, scratch=str(tmp_path / "scratch")),
+    )
+    model.remote(backend, accelerator="v5litepod-8", topology="2x4")
+    model._artifact = None
+    return model, backend
+
+
+def test_pod_backend_full_lifecycle(pod_model):
+    model, backend = pod_model
+
+    version = model.remote_deploy(app_version="pod-v1")
+    assert version == "pod-v1"
+    spec = backend.fetch_workflow_spec("backend_model.train", "pod-v1")
+    assert spec["resources"]["accelerator"] == "v5litepod-8"
+    assert "gpu" not in str(spec["resources"]).lower()
+    # deploy packaged the app source into the store
+    assert backend._source_zip("pod-v1").exists()
+
+    artifact = model.remote_train(
+        app_version="pod-v1", hyperparameters={"max_iter": 200}, n=60, wait=True
+    )
+    assert artifact is not None
+    assert set(artifact.metrics) == {"train", "test"}
+    assert artifact.metrics["test"] > 0.7
+
+    assert model.remote_list_model_versions() != []
+
+    predictions = model.remote_predict(app_version="pod-v1", n=20, wait=True)
+    assert len(predictions) == 20
+
+    features = [{"x1": 1.0, "x2": 1.0}, {"x1": -2.0, "x2": -2.0}]
+    predictions = model.remote_predict(app_version="pod-v1", features=features, wait=True)
+    assert predictions == [1.0, 0.0]
+
+
+def test_pod_backend_ships_source_zip(tmp_path, monkeypatch):
+    """The worker must run the app from the STORE's zip, not the local file: the
+    original source is deleted between deploy and execute."""
+    monkeypatch.setenv("PYTHONPATH", str(REPO_ROOT))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.chdir(REPO_ROOT)
+
+    app_dir = tmp_path / "appsrc"
+    app_dir.mkdir()
+    app_file = app_dir / "shipped_app.py"
+    app_file.write_text(
+        textwrap.dedent(
+            """
+            from typing import List
+
+            import numpy as np
+            import pandas as pd
+
+            from unionml_tpu import Dataset, Model
+
+            dataset = Dataset(name="shipped_ds", targets=["y"], test_size=0.25)
+            model = Model(name="shipped_model", init=lambda **hp: dict(hp), dataset=dataset)
+
+            @dataset.reader
+            def reader(n: int = 40) -> pd.DataFrame:
+                rng = np.random.default_rng(0)
+                x = rng.normal(size=n)
+                return pd.DataFrame({"x": x, "y": (x > 0).astype(float)})
+
+            @model.trainer
+            def trainer(m: dict, X: pd.DataFrame, y: pd.DataFrame) -> dict:
+                return {"t": float(X["x"].median())}
+
+            @model.predictor
+            def predictor(m: dict, X: pd.DataFrame) -> List[float]:
+                return [float(v > m["t"]) for v in X["x"]]
+
+            @model.evaluator
+            def evaluator(m: dict, X: pd.DataFrame, y: pd.DataFrame) -> float:
+                return float(np.mean([float(v > m["t"]) for v in X["x"]] == y["y"].to_numpy()))
+            """
+        )
+    )
+    sys.path.insert(0, str(app_dir))
+    try:
+        import shipped_app  # noqa: F401  (registers the tracked model)
+
+        from unionml_tpu.backend.tpu_pod import LocalShellTransport, TPUPodBackend
+
+        backend = TPUPodBackend(
+            store_url=f"file://{tmp_path}/store",
+            transport=LocalShellTransport(host_count=1, scratch=str(tmp_path / "scratch")),
+        )
+        shipped_app.model.remote(backend)
+        shipped_app.model.remote_deploy(app_version="zip-v1")
+        assert backend._source_zip("zip-v1").exists()
+
+        # the machine boundary: the worker subprocess has no app_dir on its path and
+        # the original file is GONE — only the store's zip can supply the source
+        shutil.rmtree(app_dir)
+
+        execution = shipped_app.model.remote_train(app_version="zip-v1", n=30, wait=False)
+        backend.wait(execution, timeout=120)
+        assert execution.status == "SUCCEEDED"
+        outputs = execution.outputs
+        assert "metrics" in outputs
+    finally:
+        sys.path.remove(str(app_dir))
+        sys.modules.pop("shipped_app", None)
+
+
+def test_pod_backend_multihost_fleet(tmp_path, monkeypatch):
+    """host_count=2 spawns a coordinated 2-process fleet through the transport."""
+    monkeypatch.setenv("PYTHONPATH", str(REPO_ROOT))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.chdir(REPO_ROOT)
+
+    from tests.integration.backend_app import model
+    from unionml_tpu.backend.tpu_pod import LocalShellTransport, TPUPodBackend
+    from unionml_tpu.defaults import Resources
+
+    backend = TPUPodBackend(
+        store_url=f"file://{tmp_path}/store",
+        transport=LocalShellTransport(host_count=2, scratch=str(tmp_path / "scratch")),
+    )
+    model.remote(backend, resources=Resources(accelerator="v5litepod-8", host_count=2))
+    model._artifact = None
+    model.remote_deploy(app_version="mh-v1")
+    execution = model.remote_train(app_version="mh-v1", n=40, wait=False)
+    backend.wait(execution, timeout=180)
+    assert execution.status == "SUCCEEDED"
+    fleet_meta = (execution.directory / "fleet.json").read_text()
+    assert "loopback-1" in fleet_meta and "127.0.0.1:" in fleet_meta
+
+
+def test_pod_backend_host_count_exceeds_transport(tmp_path, monkeypatch):
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path))
+    monkeypatch.chdir(REPO_ROOT)
+    from tests.integration.backend_app import model
+    from unionml_tpu.backend.tpu_pod import LocalShellTransport, TPUPodBackend
+    from unionml_tpu.defaults import Resources
+    from unionml_tpu.exceptions import BackendError
+
+    backend = TPUPodBackend(
+        store_url=f"file://{tmp_path}/store",
+        transport=LocalShellTransport(host_count=1, scratch=str(tmp_path / "scratch")),
+    )
+    model.remote(backend, resources=Resources(host_count=4))
+    with pytest.raises(BackendError, match="host_count=4"):
+        model.remote_train(app_version=None, n=10, wait=False)
+
+
+def test_parse_pod_target_and_model_remote_string(tmp_path, monkeypatch):
+    """Model.remote(backend='tpu-pod://...') builds a working pod backend."""
+    monkeypatch.setenv("PYTHONPATH", str(REPO_ROOT))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.chdir(REPO_ROOT)
+
+    from unionml_tpu.backend.tpu_pod import (
+        LocalShellTransport,
+        SSHTransport,
+        TPUPodBackend,
+        parse_pod_target,
+    )
+
+    transport, options = parse_pod_target(f"tpu-pod://local?store=file://{tmp_path}/s&hosts=2")
+    assert isinstance(transport, LocalShellTransport) and len(transport.hosts) == 2
+    transport, _ = parse_pod_target("tpu-pod://tpu-vm-0,tpu-vm-1?store=gs://bucket/p")
+    assert isinstance(transport, SSHTransport) and transport.hosts == ["tpu-vm-0", "tpu-vm-1"]
+
+    from tests.integration.backend_app import model
+    from unionml_tpu.defaults import Resources
+
+    # backend_app.model is module-global: earlier tests may have left multi-host
+    # resources on it, so pin the single-host shape this test needs
+    model.remote(
+        backend=f"tpu-pod://local?store=file://{tmp_path}/store",
+        resources=Resources(accelerator="v5litepod-8", host_count=1),
+    )
+    backend = model._remote
+    assert isinstance(backend, TPUPodBackend)
+
+    model._artifact = None
+    model.remote_deploy(app_version="str-v1")
+    artifact = model.remote_train(app_version="str-v1", n=40, wait=True)
+    assert artifact.metrics["test"] > 0.6

@@ -160,6 +160,26 @@ def test_feature_type_host_annotated_loader_keeps_array_contract():
         return X @ p["w"]
 
 
+def test_seq_buckets_do_not_pad_flat_float_leaves():
+    """Review regression: a rank-2 float leaf (dense features) must keep its width
+    even when seq_buckets is configured; only token-shaped leaves pad dim 1."""
+    model = _build_tokenized_model()
+    resident = ResidentPredictor(model, buckets=(2,), seq_buckets=(16,), warmup=False)
+    resident.setup()
+    mixed = {
+        "input_ids": np.ones((2, 5), dtype=np.int32),
+        "attention_mask": np.ones((2, 5), dtype=np.int32),
+        "dense": np.ones((2, 10), dtype=np.float32),
+        "embeddings": np.ones((2, 5, 4), dtype=np.float32),
+    }
+    padded, n, bucket = resident._pad_to_buckets(mixed)
+    assert n == 2 and bucket == 2
+    assert padded["input_ids"].shape == (2, 16)  # int leaf: seq-padded
+    assert padded["attention_mask"].shape == (2, 16)
+    assert padded["dense"].shape == (2, 10)  # flat float leaf: width untouched
+    assert padded["embeddings"].shape == (2, 16, 4)  # rank-3: dim 1 is sequence
+
+
 def test_resident_flat_features_warmup_unchanged():
     """Flat feature-column datasets still warm up from metadata alone."""
     dataset = Dataset(name="flat_ds", features=["a", "b"], targets=["y"], device_format="jax")

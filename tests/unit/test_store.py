@@ -1,0 +1,85 @@
+"""Artifact store: the pathlib-compatible fsspec wrapper behind TPUPodBackend."""
+
+import pickle
+
+import pytest
+
+from unionml_tpu.backend.store import StorePath, store_path
+
+
+def test_store_path_memory_roundtrip():
+    root = store_path("memory://store-unit-test")
+    d = root / "executions" / "e1"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "status").write_text("QUEUED")
+    assert (d / "status").read_text() == "QUEUED"
+    assert (d / "status").exists()
+    assert not (d / "missing").exists()
+    with (d / "outputs.pkl").open("wb") as f:
+        pickle.dump({"metrics": 1.0}, f)
+    with (d / "outputs.pkl").open("rb") as f:
+        assert pickle.load(f) == {"metrics": 1.0}
+    names = sorted(p.name for p in d.iterdir())
+    assert names == ["outputs.pkl", "status"]
+
+
+def test_store_path_url_roundtrip_across_reconstruction():
+    root = store_path("memory://roundtrip-test")
+    (root / "a.txt").write_text("hello")
+    rebuilt = store_path(str(root))
+    assert (rebuilt / "a.txt").read_text() == "hello"
+
+
+def test_store_path_file_protocol(tmp_path):
+    root = store_path(f"file://{tmp_path}/sub")
+    (root / "x" / "y.txt").write_text("deep write creates parents")
+    assert (tmp_path / "sub" / "x" / "y.txt").read_text() == "deep write creates parents"
+    assert (root / "x").is_dir()
+    assert (root / "x" / "y.txt").stat().st_mtime > 0
+    (root / "x" / "y.txt").unlink()
+    assert not (root / "x" / "y.txt").exists()
+    with pytest.raises(FileNotFoundError):
+        (root / "x" / "y.txt").unlink()
+    (root / "x" / "y.txt").unlink(missing_ok=True)
+
+
+def test_store_path_rejects_bad_url():
+    with pytest.raises(ValueError, match="protocol"):
+        store_path("not-a-url-at-all://")
+
+
+def test_store_path_bare_relative_path(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    root = store_path("relative/dir")
+    (root / "f.txt").write_text("ok")
+    assert (tmp_path / "relative" / "dir" / "f.txt").read_text() == "ok"
+
+
+def test_ssh_transport_poll_survives_transport_failure(monkeypatch):
+    """A failing ssh probe must read as 'alive' (unknown), never as worker death."""
+    import subprocess as sp
+
+    from unionml_tpu.backend.tpu_pod import SSHTransport
+
+    transport = SSHTransport(["tpu-host"])
+    assert transport.python == "python3"  # remote interpreter, not the client's
+
+    monkeypatch.setattr(
+        transport,
+        "_ssh",
+        lambda host, cmd: sp.CompletedProcess(args=[], returncode=255, stdout="", stderr="net down"),
+    )
+    assert transport.poll(("tpu-host", 1234)) is None
+
+    def boom(host, cmd):
+        raise sp.TimeoutExpired(cmd="ssh", timeout=120)
+
+    monkeypatch.setattr(transport, "_ssh", boom)
+    assert transport.poll(("tpu-host", 1234)) is None
+
+    monkeypatch.setattr(
+        transport,
+        "_ssh",
+        lambda host, cmd: sp.CompletedProcess(args=[], returncode=0, stdout="DEAD\n", stderr=""),
+    )
+    assert transport.poll(("tpu-host", 1234)) == 0

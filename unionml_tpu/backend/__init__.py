@@ -639,10 +639,24 @@ def backend_from_config(
         domain = domain or backend_cfg.get("domain")
         in_process = bool(backend_cfg.get("in_process", False))
     if target:
+        if target.startswith("tpu-pod://"):
+            from unionml_tpu.backend.tpu_pod import TPUPodBackend, parse_pod_target
+
+            transport, options = parse_pod_target(target)
+            return TPUPodBackend(
+                store_url=options["store"],
+                transport=transport,
+                project=project or options.get("project"),
+                domain=domain or options.get("domain"),
+                retries=int(options.get("retries", "0")),
+            )
         if target.startswith("local://"):
             root = Path(target[len("local://") :]) if len(target) > len("local://") else None
         elif target not in ("local", "sandbox"):
-            raise BackendError(f"Unknown backend target {target!r}; expected 'local', 'sandbox', or 'local://<path>'")
+            raise BackendError(
+                f"Unknown backend target {target!r}; expected 'local', 'sandbox', "
+                f"'local://<path>', or 'tpu-pod://<hosts>?store=<url>'"
+            )
     return LocalBackend(root=root, project=project, domain=domain, in_process=in_process)
 
 

@@ -1,0 +1,64 @@
+"""Pod worker entrypoint: store-backed execution on a TPU VM host.
+
+The pod-fleet analogue of :mod:`unionml_tpu.backend.worker` (which receives a local
+execution directory): this entrypoint receives the execution's STORE URL, pulls the
+packaged app source from the store, installs it on ``sys.path``, and then runs the
+standard worker body against the store-backed execution "directory" — every status,
+error, and output write lands in the shared store where the client (and the other
+hosts) can see it.
+
+Usage (launched by :class:`unionml_tpu.backend.tpu_pod.TPUPodBackend` via transport)::
+
+    python -m unionml_tpu.backend.pod_worker <execution-url> [--source <zip-url>]
+
+Multi-host jobs receive ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+``JAX_PROCESS_ID`` in the environment; ``worker.run_execution`` joins the
+``jax.distributed`` mesh before any computation (reference boundary:
+``unionml/task_resolver.py:16-31`` running inside the remote container).
+"""
+
+import argparse
+import io
+import json
+import sys
+import tempfile
+import zipfile
+from pathlib import Path
+from typing import Optional
+
+
+def install_source(source_url: str) -> Optional[str]:
+    """Download + extract the app source zip; returns the local module file path."""
+    from unionml_tpu.backend.store import store_path
+
+    source = store_path(source_url)
+    if not source.exists():
+        return None
+    scratch = Path(tempfile.mkdtemp(prefix="unionml-app-src-"))
+    with zipfile.ZipFile(io.BytesIO(source.read_bytes())) as zf:
+        zf.extractall(scratch)
+    sys.path.insert(0, str(scratch))
+    manifest = scratch / "__unionml_source__.json"
+    if manifest.exists():
+        rel = json.loads(manifest.read_text()).get("module_file")
+        if rel and (scratch / rel).exists():
+            return str(scratch / rel)
+    return None
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("execution_url")
+    parser.add_argument("--source", default=None)
+    args = parser.parse_args()
+
+    from unionml_tpu.backend.store import store_path
+    from unionml_tpu.backend.worker import run_execution
+
+    module_file_override = install_source(args.source) if args.source else None
+    execution_dir = store_path(args.execution_url)
+    raise SystemExit(run_execution(execution_dir, module_file_override=module_file_override))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""Artifact store: a Path-like view over fsspec filesystems (local / GCS / memory).
+
+The reference moves inter-task data and model artifacts through Flyte's blob store
+(S3/minio — ``tests/integration/test_flyte_remote.py`` CI wiring sets minio creds).
+The TPU-native equivalent is GCS: pod workers and the client share one bucket for job
+records, inputs, outputs, and packaged app source. :class:`StorePath` exposes the small
+pathlib surface the backend uses (join, mkdir, open, read/write_text, exists, iterdir)
+over any fsspec URL, so the same backend code runs against:
+
+- ``file:///...``  — local filesystem (tests, single-machine)
+- ``gs://bucket/prefix`` — Google Cloud Storage via gcsfs (real TPU pod fleets)
+- ``memory://...`` — in-process fake (unit tests; NOT visible across processes)
+
+A ``StorePath`` stringifies back to its URL, so it can cross a process boundary as a
+CLI argument and be reconstructed with :func:`store_path` on the other side (the pod
+worker does exactly this).
+"""
+
+import io
+import posixpath
+from typing import Any, Iterator, List, Optional, Tuple
+
+import fsspec
+
+
+class _StoreStat:
+    __slots__ = ("st_mtime", "st_size")
+
+    def __init__(self, st_mtime: float, st_size: int):
+        self.st_mtime = st_mtime
+        self.st_size = st_size
+
+
+class StorePath:
+    """Minimal pathlib-compatible wrapper over an fsspec filesystem.
+
+    Implements exactly the operations the execution backend performs on its root:
+    ``/`` joining, ``name``, ``mkdir``, ``exists``, ``is_dir``, ``iterdir``, ``open``,
+    ``read_text``/``write_text``, ``stat().st_mtime``, and ``unlink``.
+    """
+
+    def __init__(self, fs: fsspec.AbstractFileSystem, path: str, protocol: str):
+        self._fs = fs
+        self._path = path.rstrip("/") or "/"
+        self._protocol = protocol
+
+    # ---------------------------------------------------------------- identity
+
+    @property
+    def name(self) -> str:
+        return posixpath.basename(self._path)
+
+    @property
+    def url(self) -> str:
+        return f"{self._protocol}://{self._path.lstrip('/') if self._protocol != 'file' else self._path}"
+
+    def __str__(self) -> str:
+        return self.url
+
+    def __repr__(self) -> str:
+        return f"StorePath({self.url!r})"
+
+    def __truediv__(self, other: str) -> "StorePath":
+        return StorePath(self._fs, posixpath.join(self._path, str(other)), self._protocol)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, StorePath) and other.url == self.url
+
+    def __hash__(self) -> int:
+        return hash(self.url)
+
+    # ---------------------------------------------------------------- fs ops
+
+    def mkdir(self, parents: bool = False, exist_ok: bool = False) -> None:
+        # object stores have no real directories; makedirs is a no-op marker there,
+        # which is exactly the semantics the backend needs
+        try:
+            self._fs.makedirs(self._path, exist_ok=exist_ok or parents)
+        except FileExistsError:
+            if not exist_ok:
+                raise
+
+    def exists(self) -> bool:
+        return bool(self._fs.exists(self._path))
+
+    def is_dir(self) -> bool:
+        try:
+            return bool(self._fs.isdir(self._path))
+        except Exception:
+            return False
+
+    def iterdir(self) -> Iterator["StorePath"]:
+        if not self.exists():
+            return
+        for entry in self._fs.ls(self._path, detail=False):
+            entry_path = entry if isinstance(entry, str) else entry["name"]
+            entry_path = entry_path.rstrip("/")
+            if entry_path and entry_path != self._path:
+                yield StorePath(self._fs, entry_path, self._protocol)
+
+    def open(self, mode: str = "r"):
+        if "r" in mode and not self._fs.exists(self._path):
+            raise FileNotFoundError(self._path)
+        return self._fs.open(self._path, mode)
+
+    def read_text(self) -> str:
+        with self.open("r") as f:
+            data = f.read()
+        return data.decode() if isinstance(data, bytes) else data
+
+    def write_text(self, text: str) -> int:
+        parent = posixpath.dirname(self._path)
+        if parent:
+            self._fs.makedirs(parent, exist_ok=True)
+        with self._fs.open(self._path, "w") as f:
+            f.write(text)
+        return len(text)
+
+    def read_bytes(self) -> bytes:
+        with self.open("rb") as f:
+            return f.read()
+
+    def write_bytes(self, data: bytes) -> int:
+        parent = posixpath.dirname(self._path)
+        if parent:
+            self._fs.makedirs(parent, exist_ok=True)
+        with self._fs.open(self._path, "wb") as f:
+            f.write(data)
+        return len(data)
+
+    def unlink(self, missing_ok: bool = False) -> None:
+        try:
+            self._fs.rm(self._path)
+        except FileNotFoundError:
+            if not missing_ok:
+                raise
+
+    def stat(self) -> _StoreStat:
+        info = self._fs.info(self._path)
+        mtime = info.get("mtime") or info.get("LastModified") or info.get("created") or 0
+        if hasattr(mtime, "timestamp"):
+            mtime = mtime.timestamp()
+        return _StoreStat(float(mtime or 0), int(info.get("size") or 0))
+
+
+def store_path(url: str) -> StorePath:
+    """Build a :class:`StorePath` from an fsspec URL (``file://``, ``gs://``, ...).
+
+    Bare filesystem paths (no ``://``) are accepted and absolutized.
+    """
+    import os
+
+    if "://" not in url:
+        return StorePath(fsspec.filesystem("file"), os.path.abspath(url), "file")
+    protocol, _, rest = url.partition("://")
+    if not rest:
+        raise ValueError(f"Store URL must look like '<protocol>://<path>', got {url!r}")
+    if protocol == "file":
+        rest = os.path.abspath(rest)
+    return StorePath(fsspec.filesystem(protocol), rest, protocol)

@@ -66,12 +66,21 @@ def run_workflow_for_model(model: Any, workflow_name: str, inputs: Dict[str, Any
     return _plain_inputs(dict(zip(names, result)))
 
 
-def run_execution(execution_dir: Path) -> int:
+def run_execution(execution_dir: Path, module_file_override: str = None) -> int:
+    """Run one execution from its (local or store-backed) directory.
+
+    ``module_file_override``: local path of the app module when the recorded
+    ``module_file`` belongs to another machine (pod workers extract the shipped
+    source zip and pass its location — see ``unionml_tpu.backend.pod_worker``).
+    """
     from unionml_tpu._logging import logger
     from unionml_tpu.tracker import load_tracked_instance
 
     with (execution_dir / "meta.json").open() as f:
-        meta = json.load(f)
+        raw = f.read()
+    meta = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+    if module_file_override:
+        meta["module_file"] = module_file_override
     (execution_dir / "status").write_text("RUNNING")
 
     host_index = 0

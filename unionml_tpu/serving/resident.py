@@ -159,7 +159,12 @@ class ResidentPredictor:
             pad = [(0, 0)] * a.ndim
             if bucket != n:
                 pad[0] = (0, bucket - n)
-            if self._seq_buckets is not None and a.ndim >= 2:
+            # dim 1 is a sequence axis for integer leaves (token ids / masks) and
+            # rank>=3 leaves (batch, seq, features); a rank-2 FLOAT leaf is a flat
+            # feature matrix whose width must never be padded (a dense (b, 10)
+            # input would otherwise grow fabricated zero columns)
+            is_seq_leaf = np.issubdtype(a.dtype, np.integer) or a.ndim >= 3
+            if self._seq_buckets is not None and a.ndim >= 2 and is_seq_leaf:
                 seq = a.shape[1]
                 seq_bucket = _ladder_value(self._seq_buckets, seq)
                 if seq_bucket != seq:
