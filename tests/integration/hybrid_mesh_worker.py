@@ -1,0 +1,62 @@
+"""Per-process body for the hybrid ICI×DCN mesh placement test.
+
+Run as: python hybrid_mesh_worker.py <process_id> <num_processes> <coordinator>
+
+Each process owns 4 virtual CPU devices (standing in for one slice's ICI domain);
+``make_hybrid_mesh`` must place the DCN axis exactly on process boundaries — every
+device in mesh row r belongs to process r — and a psum over the DCN axis must cross
+the process boundary. A silent-reshape regression (round-1 weak #5) fails the
+placement assertions.
+"""
+
+import os
+import sys
+
+process_id, num_processes, coordinator = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from unionml_tpu.parallel.distributed import initialize_distributed, is_primary_host  # noqa: E402
+from unionml_tpu.parallel.mesh import make_hybrid_mesh  # noqa: E402
+
+initialize_distributed(
+    coordinator_address=coordinator,
+    num_processes=num_processes,
+    process_id=process_id,
+    strict=True,
+)
+assert jax.device_count() == 4 * num_processes
+
+mesh = make_hybrid_mesh(ici_axes={"data": 4}, dcn_axes={"replica": num_processes})
+assert mesh.axis_names == ("replica", "data"), mesh.axis_names
+assert mesh.devices.shape == (num_processes, 4), mesh.devices.shape
+
+# the DCN ("replica") axis must land exactly on process boundaries
+for replica in range(num_processes):
+    owners = {d.process_index for d in mesh.devices[replica]}
+    assert owners == {replica}, f"replica {replica} spans processes {owners}"
+
+# and a collective over the DCN axis must really cross processes: each replica
+# contributes its (process_index + 1), so the psum is the same on every device
+local = np.full((4, 8), float(process_id + 1), dtype=np.float32)
+sharding = NamedSharding(mesh, P("replica", "data"))
+garr = jax.make_array_from_process_local_data(sharding, local, (num_processes * 4, 8))
+
+
+@jax.jit
+def reduce_over_replicas(x):
+    return jnp.sum(x)
+
+
+total = float(reduce_over_replicas(garr))
+expected = float(sum((p + 1) * 4 * 8 for p in range(num_processes)))
+assert total == expected, (total, expected)
+
+if is_primary_host():
+    print(f"HYBRID_MESH_OK replicas={num_processes} placement=per-process total={total}")

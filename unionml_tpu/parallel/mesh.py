@@ -102,15 +102,30 @@ def make_hybrid_mesh(
     ici_shape = tuple(ici_axes.get(name, 1) for name in names)
     dcn_shape = tuple(dcn_axes.get(name, 1) for name in names)
     try:
+        # TPU slices: slice_index is the DCN granule
         device_array = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=ici_shape,
             dcn_mesh_shape=dcn_shape,
         )
-    except ValueError:
-        # CPU / emulated devices carry no slice_index: fall back to a plain reshape with
-        # the same logical shape (ici x dcn per axis) so tests can exercise the layout
-        total = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
-        device_array = np.asarray(jax.devices()[: int(np.prod(total))]).reshape(total)
+    except (ValueError, AssertionError):
+        try:
+            # multi-process CPU/GPU fleets: the PROCESS is the DCN granule, so the
+            # dcn axes still land on real host boundaries (honest placement)
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=ici_shape,
+                dcn_mesh_shape=dcn_shape,
+                process_is_granule=True,
+            )
+        except (ValueError, AssertionError):
+            if jax.process_count() > 1:
+                # never silently reshape a real multi-host fleet: a wrong layout
+                # would put "DCN" axes across arbitrary devices and hide the
+                # placement bug the hybrid mesh exists to prevent
+                raise
+            # single-process emulation (unit tests): plain reshape with the same
+            # logical shape; there is no host boundary to misplace
+            total = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+            device_array = np.asarray(jax.devices()[: int(np.prod(total))]).reshape(total)
     return Mesh(device_array, tuple(names))
 
 

@@ -5,15 +5,18 @@ bentoml integration; the serverless handler replaces the reference's Mangum/AWS-
 *pattern* (shipped only via templates/tests there) with a first-class adapter.
 """
 
+from unionml_tpu.services.bentoml_service import (  # noqa: F401
+    BentoMLService,
+    create_runnable,
+    create_service,
+    infer_io_descriptors,
+)
 from unionml_tpu.services.event_handler import make_event_handler
-from unionml_tpu.utils import module_is_installed
 
-if module_is_installed("bentoml"):
-    from unionml_tpu.services.bentoml_service import (  # noqa: F401
-        BentoMLService,
-        create_runnable,
-        create_service,
-        infer_io_descriptors,
-    )
-
-__all__ = ["make_event_handler"]
+__all__ = [
+    "BentoMLService",
+    "create_runnable",
+    "create_service",
+    "infer_io_descriptors",
+    "make_event_handler",
+]
