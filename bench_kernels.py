@@ -1,0 +1,187 @@
+"""Flash-attention kernel sweep: pallas (Mosaic) vs XLA at BERT shapes.
+
+VERDICT round-1 next-step #2: the pallas kernels must compile on real hardware
+(``interpret=False``), be timed against ``xla_attention``, and have their block sizes
+chosen from data. This harness does exactly that:
+
+- sweeps ``(block_q, block_k)`` over MXU-aligned candidates for each shape class
+  (seq 128 and 512, head_dim 64 — the BERT-base fine-tune shapes);
+- times forward AND forward+backward, steady-state, cold compile excluded;
+- records per-shape winners + the pallas-vs-XLA verdict into ``KERNEL_BENCH.json``.
+  If the kernel loses to XLA's fused attention at a shape, the recorded verdict is
+  ``"use_xla"`` — paste winners into ``unionml_tpu/ops/tuning.py::TUNED_BLOCKS`` only
+  where pallas wins.
+
+On CPU there is nothing honest to time (interpret mode is an emulation), so the
+harness runs a correctness sweep instead: every candidate block config is validated
+numerically (forward and grads) in interpret mode, and the JSON says so.
+"""
+
+import json
+import sys
+import time
+from datetime import datetime, timezone
+
+
+def _time(fn, *args, iters=20, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms/iter
+
+
+def sweep_tpu(shapes, candidates):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.ops.attention import flash_attention, xla_attention
+
+    results = {}
+    for batch, heads, seq, head_dim in shapes:
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(batch, heads, seq, head_dim)), dtype=jnp.bfloat16)
+            for _ in range(3)
+        )
+
+        def grad_norm(fn):
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        xla_fwd = _time(jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=True)), q, k, v)
+        xla_bwd = _time(grad_norm(lambda q, k, v: xla_attention(q, k, v, causal=True)), q, k, v)
+
+        table = []
+        for block_q in candidates:
+            for block_k in candidates:
+                if seq % block_q or seq % block_k:
+                    continue
+                try:
+                    fwd = _time(
+                        jax.jit(
+                            lambda q, k, v, bq=block_q, bk=block_k: flash_attention(
+                                q, k, v, causal=True, block_q=bq, block_k=bk
+                            )
+                        ),
+                        q, k, v,
+                    )
+                    bwd = _time(
+                        grad_norm(
+                            lambda q, k, v, bq=block_q, bk=block_k: flash_attention(
+                                q, k, v, causal=True, block_q=bq, block_k=bk
+                            )
+                        ),
+                        q, k, v,
+                    )
+                    out = flash_attention(q, k, v, causal=True, block_q=block_q, block_k=block_k)
+                    ref = xla_attention(q, k, v, causal=True)
+                    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+                    table.append({"block_q": block_q, "block_k": block_k,
+                                  "fwd_ms": round(fwd, 4), "fwdbwd_ms": round(bwd, 4),
+                                  "max_err_vs_xla": err})
+                    print(f"[kernels] seq={seq} bq={block_q} bk={block_k} "
+                          f"fwd={fwd:.3f}ms fwd+bwd={bwd:.3f}ms", file=sys.stderr)
+                except Exception as exc:
+                    table.append({"block_q": block_q, "block_k": block_k,
+                                  "error": f"{type(exc).__name__}: {exc}"})
+                    print(f"[kernels] seq={seq} bq={block_q} bk={block_k} FAILED: {exc}",
+                          file=sys.stderr)
+
+        ok = [row for row in table if "fwdbwd_ms" in row]
+        best = min(ok, key=lambda r: r["fwdbwd_ms"]) if ok else None
+        results[f"b{batch}_h{heads}_s{seq}_d{head_dim}"] = {
+            "xla_fwd_ms": round(xla_fwd, 4),
+            "xla_fwdbwd_ms": round(xla_bwd, 4),
+            "sweep": table,
+            "best": best,
+            "verdict": (
+                "use_pallas" if best and best["fwdbwd_ms"] < xla_bwd else "use_xla"
+            ) if best is not None else "pallas_failed_use_xla",
+        }
+    return results
+
+
+def correctness_sweep_cpu(shapes, candidates):
+    """CPU fallback: validate every block config numerically in interpret mode."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.ops.attention import flash_attention, xla_attention
+
+    results = {}
+    for batch, heads, seq, head_dim in shapes:
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(batch, heads, seq, head_dim)), dtype=jnp.float32)
+            for _ in range(3)
+        )
+        ref = xla_attention(q, k, v, causal=True)
+        ref_grads = jax.grad(
+            lambda q, k, v: jnp.sum(xla_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+        rows = []
+        for block_q in candidates:
+            for block_k in candidates:
+                if seq % block_q or seq % block_k:
+                    continue
+                out = flash_attention(q, k, v, causal=True, block_q=block_q, block_k=block_k,
+                                      interpret=True)
+                err = float(jnp.max(jnp.abs(out - ref)))
+                # backward kernels are block-size-dependent too: vet them per config
+                grads = jax.grad(
+                    lambda q, k, v, bq=block_q, bk=block_k: jnp.sum(
+                        flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk,
+                                        interpret=True) ** 2
+                    ),
+                    argnums=(0, 1, 2),
+                )(q, k, v)
+                grad_err = max(
+                    float(jnp.max(jnp.abs(g - r))) for g, r in zip(grads, ref_grads)
+                )
+                rows.append({"block_q": block_q, "block_k": block_k, "max_err": err,
+                             "max_grad_err": grad_err,
+                             "ok": err < 1e-4 and grad_err < 1e-2})
+        results[f"b{batch}_h{heads}_s{seq}_d{head_dim}"] = {
+            "mode": "cpu-interpret-correctness-only", "sweep": rows,
+            "all_ok": all(r["ok"] for r in rows),
+        }
+        print(f"[kernels] seq={seq}: {len(rows)} block configs validated, "
+              f"all_ok={all(r['ok'] for r in rows)}", file=sys.stderr)
+    return results
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    shapes = [(8, 12, 128, 64), (4, 12, 512, 64)]  # BERT-base fine-tune + long-seq
+    candidates = (128, 256, 512)
+
+    if backend == "cpu":
+        shapes = [(2, 2, 128, 64), (1, 2, 256, 64)]  # interpret mode is slow
+        results = correctness_sweep_cpu(shapes, candidates)
+        payload = {"backend": backend, "timing_valid": False, "results": results}
+    else:
+        results = sweep_tpu(shapes, candidates)
+        payload = {"backend": backend, "timing_valid": True, "results": results}
+
+    payload["recorded_at"] = datetime.now(timezone.utc).isoformat(timespec="seconds")
+    with open("KERNEL_BENCH.json", "w") as fh:
+        json.dump(payload, fh, indent=2)
+    print(json.dumps({"metric": "kernel_sweep", "backend": backend,
+                      "timing_valid": payload["timing_valid"],
+                      "shapes": len(results)}))
+
+
+if __name__ == "__main__":
+    main()

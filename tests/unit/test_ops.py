@@ -128,3 +128,29 @@ def test_forward_residuals_lse():
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     ref_lse = jax.scipy.special.logsumexp(logits, axis=-1)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=1e-5)
+
+
+def test_pick_block_sizes_alignment():
+    """Block defaults resolve through the tuning table and stay seq-aligned."""
+    from unionml_tpu.ops.tuning import TUNED_BLOCKS, pick_block_sizes
+
+    assert pick_block_sizes(128, 128, 64) == (128, 128)
+    assert pick_block_sizes(512, 512, 64) == (128, 128)  # bounded guess until measured
+    assert pick_block_sizes(96, 96, 64) == (96, 96)  # tiny seq: one block
+    # irregular (non-multiple-of-8) seqs get NON-dividing blocks so the kernel's
+    # alignment check routes to the XLA fallback instead of a doomed Mosaic compile
+    assert pick_block_sizes(100, 100, 64) == (128, 128)
+    # a measured winner overrides the fallback
+    TUNED_BLOCKS[(512, 512, 64)] = (256, 512)
+    try:
+        assert pick_block_sizes(512, 512, 64) == (256, 512)
+    finally:
+        TUNED_BLOCKS.pop((512, 512, 64))
+
+
+def test_flash_attention_default_blocks_resolve(qkv):
+    """block_q/block_k=None must resolve via tuning and still match XLA."""
+    q, k, v = qkv
+    out = flash_attention(q, k, v, interpret=True)
+    ref = xla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

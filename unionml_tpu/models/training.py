@@ -200,18 +200,26 @@ def fit(
         if prefetch_loader is not None:
             sharding = batch_sharding(mesh) if mesh is not None else None
             axis = batch_axis_size(mesh) if mesh is not None else 1
-            # copy=True (the default) hands over loader-independent arrays, which is
-            # required here: device transfers are async and would otherwise race the
-            # slot ring recycling
-            for views in prefetch_loader.epoch(rng=epoch_rng):
+            # copy=False feeds the loader's python-owned slot buffers straight to
+            # device_put (zero host copies after the native gather) — safe ONLY for
+            # real accelerators, where the transfer lands in separate device memory
+            # and block_until_ready fences it. The CPU backend may ALIAS an aligned
+            # host array instead of copying, so slot recycling would corrupt
+            # "transferred" batches — keep the host copy there.
+            zero_copy = jax.default_backend() != "cpu"
+            for views in prefetch_loader.epoch(rng=epoch_rng, copy=not zero_copy):
                 if sharding is not None:
                     n = len(next(iter(views.values())))
                     wrap = wrapped_row_indices(n, axis)
                     if wrap is not None:  # ragged tail batch: wrap real rows to fit the mesh
                         views = {k: v[wrap] for k, v in views.items()}
-                    yield {k: jax.device_put(v, sharding) for k, v in views.items()}
+                    batch = {k: jax.device_put(v, sharding) for k, v in views.items()}
+                    jax.block_until_ready(batch)
+                    yield batch
                 else:
-                    yield views
+                    batch = {k: jax.device_put(v) for k, v in views.items()}
+                    jax.block_until_ready(batch)
+                    yield batch
             return
         yield from dict_batches(data, batch_size, rng=epoch_rng, mesh=mesh)
 

@@ -22,6 +22,14 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
+#: worker-side dtype conversions (mirrors the Conv enum in prefetch.cpp):
+#: source dtype -> (code, destination numpy dtype)
+_CONV_CODES = {
+    "float64->float32": 1,
+    "int64->int32": 2,
+    "float32->bfloat16": 3,
+}
+
 
 def _build_dir() -> Path:
     return Path(os.getenv("UNIONML_TPU_HOME", Path.home() / ".unionml-tpu")) / "native"
@@ -68,6 +76,8 @@ def load_native_library() -> Optional[ctypes.CDLL]:
         lib.upf_create.argtypes = [
             ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
             ctypes.c_long,
             ctypes.c_long,
         ]
@@ -78,9 +88,10 @@ def load_native_library() -> Optional[ctypes.CDLL]:
             ctypes.c_long,
             ctypes.c_long,
             ctypes.c_long,
+            ctypes.POINTER(ctypes.c_void_p),
         ]
         lib.upf_next.restype = ctypes.c_long
-        lib.upf_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+        lib.upf_next.argtypes = [ctypes.c_void_p]
         lib.upf_release.argtypes = [ctypes.c_void_p, ctypes.c_long]
         lib.upf_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -91,13 +102,46 @@ def native_available() -> bool:
     return load_native_library() is not None
 
 
+def _bfloat16_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _resolve_conversion(array: np.ndarray, target: Optional[str]) -> Tuple[int, np.dtype]:
+    """(conv code, destination dtype) for one source array."""
+    if target is None:
+        return 0, array.dtype
+    target_dtype = _bfloat16_dtype() if target == "bfloat16" else np.dtype(target)
+    if target_dtype == array.dtype:
+        return 0, array.dtype  # no-op conversion request: plain gather
+    key = f"{array.dtype.name}->{target}"
+    code = _CONV_CODES.get(key)
+    if code is None:
+        raise ValueError(
+            f"Unsupported native conversion {key!r}; supported: {sorted(_CONV_CODES)}"
+        )
+    dst = _bfloat16_dtype() if target == "bfloat16" else np.dtype(target)
+    return code, dst
+
+
 class PrefetchLoader:
     """Iterate dict batches gathered by the native threaded prefetcher.
 
     Wraps a mapping of name -> contiguous host array; each epoch yields dict batches
-    (numpy views copied into slot buffers) in shuffled order with gathering overlapped
-    against the consumer's compute. Falls back to pure-Python batching when the native
-    library can't build.
+    in shuffled order with gathering overlapped against the consumer's compute.
+
+    Round-2 hot-path upgrades (NEXT.md item 6):
+
+    - Slot buffers are numpy arrays OWNED BY PYTHON; the C++ workers gather straight
+      into them, so ``copy=False`` consumers hand the batch to ``jax.device_put``
+      with zero additional host copies. The slot recycles only after the generator
+      resumes — block on the transfer before advancing (``fit`` does).
+    - ``convert={"name": "float32" | "int32" | "bfloat16"}`` runs the dtype
+      conversion inside the worker threads (f64->f32, i64->i32, f32->bf16 with
+      round-to-nearest-even) — the Python side never pays element-wise conversion.
+
+    Falls back to pure-Python batching when the native library can't build.
     """
 
     def __init__(
@@ -108,6 +152,7 @@ class PrefetchLoader:
         n_slots: int = 4,
         n_threads: int = 2,
         drop_remainder: bool = True,
+        convert: Optional[Dict[str, str]] = None,
     ):
         self._keys = list(data)
         self._arrays = [np.ascontiguousarray(np.asarray(data[k])) for k in self._keys]
@@ -120,17 +165,64 @@ class PrefetchLoader:
         self.n_threads = n_threads
         self.drop_remainder = drop_remainder
 
+        convert = convert or {}
+        unknown = set(convert) - set(self._keys)
+        if unknown:
+            raise ValueError(f"convert refers to unknown arrays: {sorted(unknown)}")
+        self._conv_codes: List[int] = []
+        self._dst_dtypes: List[np.dtype] = []
+        for key, array in zip(self._keys, self._arrays):
+            code, dst = _resolve_conversion(array, convert.get(key))
+            self._conv_codes.append(code)
+            self._dst_dtypes.append(dst)
+
         self._lib = load_native_library()
         self._handle = None
+        self._slot_arrays: List[List[np.ndarray]] = []
+        self._slot_ptr_table = None
         if self._lib is not None:
             n = len(self._arrays)
-            sources = (ctypes.c_void_p * n)(*[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays])
+            sources = (ctypes.c_void_p * n)(
+                *[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays]
+            )
             row_bytes = (ctypes.c_long * n)(*[a.strides[0] for a in self._arrays])
-            self._handle = self._lib.upf_create(sources, row_bytes, n, self.n_rows)
+            dst_row_bytes = (ctypes.c_long * n)(*self._dst_row_bytes())
+            conv_codes = (ctypes.c_long * n)(*self._conv_codes)
+            self._handle = self._lib.upf_create(
+                sources, row_bytes, conv_codes, dst_row_bytes, n, self.n_rows
+            )
+            self._allocate_slots()
+
+    def _dst_row_bytes(self) -> List[int]:
+        out = []
+        for array, dst in zip(self._arrays, self._dst_dtypes):
+            row_elems = int(np.prod(array.shape[1:], dtype=np.int64)) if array.ndim > 1 else 1
+            out.append(row_elems * dst.itemsize)
+        return out
+
+    def _allocate_slots(self) -> None:
+        """Python-owned destination buffers: [n_slots][n_arrays] numpy arrays."""
+        self._slot_arrays = []
+        pointers = []
+        for _ in range(self.n_slots):
+            slot = []
+            for array, dst in zip(self._arrays, self._dst_dtypes):
+                buf = np.empty((self.batch_size,) + array.shape[1:], dtype=dst)
+                slot.append(buf)
+                pointers.append(buf.ctypes.data_as(ctypes.c_void_p).value)
+            self._slot_arrays.append(slot)
+        self._slot_ptr_table = (ctypes.c_void_p * len(pointers))(*pointers)
 
     @property
     def uses_native(self) -> bool:
         return self._handle is not None
+
+    def _python_batch(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        out = {}
+        for key, array, dst in zip(self._keys, self._arrays, self._dst_dtypes):
+            gathered = array[idx]
+            out[key] = gathered.astype(dst) if dst != array.dtype else gathered
+        return out
 
     def epoch(
         self, rng: Optional[np.random.Generator] = None, copy: bool = True
@@ -138,11 +230,11 @@ class PrefetchLoader:
         """Yield one epoch of dict batches in shuffled order.
 
         ``copy=True`` (default) yields loader-independent arrays: safe for any
-        consumer, including async device transfers — the threaded gather still
-        overlaps; only a sequential memcpy remains on the consumer side.
-        ``copy=False`` yields views into the slot ring that are overwritten after the
-        generator resumes: only for consumers that fully read the data synchronously
-        inside the loop body.
+        consumer, including fully-async device transfers. ``copy=False`` yields the
+        python-owned slot arrays themselves — ZERO host copies after the worker
+        gather — which recycle after the generator resumes: the consumer must finish
+        reading (e.g. ``jax.block_until_ready`` on the device transfer) inside the
+        loop body.
         """
         indices = np.arange(self.n_rows, dtype=np.int64) if rng is None else rng.permutation(self.n_rows).astype(np.int64)
         # the native path only ever gathers FULL batches (its buffers are fixed-size);
@@ -153,16 +245,14 @@ class PrefetchLoader:
 
         def tail_batches():
             if not self.drop_remainder and remainder:
-                idx = indices[n_full * self.batch_size :]
-                yield {k: a[idx] for k, a in zip(self._keys, self._arrays)}
+                yield self._python_batch(indices[n_full * self.batch_size :])
             elif n_full == 0:
                 # degenerate tiny datasets always yield their one true batch
-                yield {k: a[indices] for k, a in zip(self._keys, self._arrays)}
+                yield self._python_batch(indices)
 
         if self._handle is None or n_full == 0:
             for b in range(n_full):
-                idx = indices[b * self.batch_size : (b + 1) * self.batch_size]
-                yield {k: a[idx] for k, a in zip(self._keys, self._arrays)}
+                yield self._python_batch(indices[b * self.batch_size : (b + 1) * self.batch_size])
             yield from tail_batches()
             return
 
@@ -175,19 +265,18 @@ class PrefetchLoader:
             self.batch_size,
             self.n_slots,
             self.n_threads,
+            self._slot_ptr_table,
         )
-        out_ptrs = (ctypes.c_void_p * len(self._arrays))()
         try:
             while True:
-                batch = self._lib.upf_next(self._handle, out_ptrs)
+                batch = self._lib.upf_next(self._handle)
                 if batch < 0:
                     break
-                views = {}
-                for key, array, ptr in zip(self._keys, self._arrays, out_ptrs):
-                    shape = (self.batch_size,) + array.shape[1:]
-                    buf = (ctypes.c_uint8 * (self.batch_size * array.strides[0])).from_address(ptr)
-                    view = np.frombuffer(buf, dtype=array.dtype).reshape(shape)
-                    views[key] = np.array(view) if copy else view
+                slot = self._slot_arrays[batch % self.n_slots]
+                views = {
+                    key: (np.array(buf) if copy else buf)
+                    for key, buf in zip(self._keys, slot)
+                }
                 yield views
                 self._lib.upf_release(self._handle, batch)
             yield from tail_batches()

@@ -9,7 +9,15 @@
 // Model: N slots (ring buffer), each holding one batch's buffers for every source
 // array. Worker threads claim batch indices in order, wait for their slot to free,
 // gather rows, and mark the slot ready. The consumer (`upf_next`) takes batches in
-// order and releases slots after device_put.
+// order and releases slots after the device transfer commits.
+//
+// Round-2 additions (NEXT.md item 6):
+//  - slot buffers are owned by PYTHON (numpy arrays registered via `upf_set_buffers`),
+//    so the consumer hands the gathered batch straight to jax.device_put with no
+//    extra host copy; the slot is released only after the transfer commits.
+//  - per-array dtype conversion runs INSIDE the worker threads during the gather:
+//    float64->float32, int64->int32, and float32->bfloat16 (round-to-nearest-even),
+//    so Python never pays element-wise conversion on the hot path.
 //
 // Build: g++ -O3 -shared -fPIC -pthread prefetch.cpp -o libunionml_prefetch.so
 // (driven by unionml_tpu/native/__init__.py; pure C ABI, consumed via ctypes).
@@ -24,17 +32,65 @@
 
 namespace {
 
+// conversion codes (mirrored in native/__init__.py)
+enum Conv : long {
+  kCopy = 0,      // raw memcpy
+  kF64ToF32 = 1,  // float64 -> float32
+  kI64ToI32 = 2,  // int64 -> int32
+  kF32ToBf16 = 3, // float32 -> bfloat16 (round to nearest even)
+};
+
+inline uint16_t f32_to_bf16(float value) {
+  uint32_t bits;
+  std::memcpy(&bits, &value, sizeof(bits));
+  // round-to-nearest-even on the dropped 16 bits; NaN stays NaN
+  if ((bits & 0x7fffffffu) > 0x7f800000u) return (uint16_t)((bits >> 16) | 0x0040u);
+  const uint32_t rounding = 0x7fffu + ((bits >> 16) & 1u);
+  return (uint16_t)((bits + rounding) >> 16);
+}
+
+inline void convert_row(uint8_t* dst, const uint8_t* src, long src_bytes, long conv) {
+  switch (conv) {
+    case kCopy:
+      std::memcpy(dst, src, (size_t)src_bytes);
+      break;
+    case kF64ToF32: {
+      const long n = src_bytes / 8;
+      const double* in = reinterpret_cast<const double*>(src);
+      float* out = reinterpret_cast<float*>(dst);
+      for (long i = 0; i < n; ++i) out[i] = (float)in[i];
+      break;
+    }
+    case kI64ToI32: {
+      const long n = src_bytes / 8;
+      const int64_t* in = reinterpret_cast<const int64_t*>(src);
+      int32_t* out = reinterpret_cast<int32_t*>(dst);
+      for (long i = 0; i < n; ++i) out[i] = (int32_t)in[i];
+      break;
+    }
+    case kF32ToBf16: {
+      const long n = src_bytes / 4;
+      const float* in = reinterpret_cast<const float*>(src);
+      uint16_t* out = reinterpret_cast<uint16_t*>(dst);
+      for (long i = 0; i < n; ++i) out[i] = f32_to_bf16(in[i]);
+      break;
+    }
+  }
+}
+
 struct Slot {
-  std::vector<std::vector<uint8_t>> buffers;  // one per source array
-  long batch_idx = -1;                        // which batch currently occupies the slot
-  long next_fill = 0;                         // the only batch allowed to fill next
+  std::vector<uint8_t*> buffers;  // PYTHON-owned destination, one per source array
+  long batch_idx = -1;            // which batch currently occupies the slot
+  long next_fill = 0;             // the only batch allowed to fill next
   bool ready = false;
   bool in_use = false;
 };
 
 struct Prefetcher {
   std::vector<const uint8_t*> sources;
-  std::vector<long> row_bytes;
+  std::vector<long> row_bytes;      // source row strides
+  std::vector<long> conv;           // per-array conversion code
+  std::vector<long> dst_row_bytes;  // destination row strides (post-conversion)
   long n_rows = 0;
 
   std::vector<long> indices;
@@ -68,10 +124,12 @@ struct Prefetcher {
     const long* batch_indices = indices.data() + batch * batch_size;
     for (size_t a = 0; a < sources.size(); ++a) {
       const long rb = row_bytes[a];
-      uint8_t* dst = slot.buffers[a].data();
+      const long drb = dst_row_bytes[a];
+      const long cv = conv[a];
+      uint8_t* dst = slot.buffers[a];
       const uint8_t* src = sources[a];
       for (long r = 0; r < batch_size; ++r) {
-        std::memcpy(dst + r * rb, src + batch_indices[r] * rb, rb);
+        convert_row(dst + r * drb, src + batch_indices[r] * rb, rb, cv);
       }
     }
     {
@@ -111,19 +169,27 @@ struct Prefetcher {
 
 extern "C" {
 
-Prefetcher* upf_create(const void** sources, const long* row_bytes, long n_arrays, long n_rows) {
+// conv_codes/dst_row_bytes describe the per-array worker-side conversion; pass
+// kCopy + row_bytes[i] for raw gathering.
+Prefetcher* upf_create(const void** sources, const long* row_bytes, const long* conv_codes,
+                       const long* dst_row_bytes, long n_arrays, long n_rows) {
   auto* p = new Prefetcher();
   p->n_rows = n_rows;
   for (long i = 0; i < n_arrays; ++i) {
     p->sources.push_back(static_cast<const uint8_t*>(sources[i]));
     p->row_bytes.push_back(row_bytes[i]);
+    p->conv.push_back(conv_codes[i]);
+    p->dst_row_bytes.push_back(dst_row_bytes[i]);
   }
   return p;
 }
 
 // Begin an epoch. `indices` must stay valid until the epoch completes.
+// `slot_buffers` is a row-major [n_slots][n_arrays] table of PYTHON-owned
+// destination pointers (each sized batch_size * dst_row_bytes[a]); they must stay
+// alive until upf_destroy or the next upf_start.
 void upf_start(Prefetcher* p, const long* indices, long n_batches, long batch_size,
-               long n_slots, long n_threads) {
+               long n_slots, long n_threads, void** slot_buffers) {
   p->stop();
   p->indices.assign(indices, indices + n_batches * batch_size);
   p->n_batches = n_batches;
@@ -133,12 +199,13 @@ void upf_start(Prefetcher* p, const long* indices, long n_batches, long batch_si
   p->stopping = false;
 
   p->slots.assign((size_t)n_slots, Slot{});
+  const size_t n_arrays = p->sources.size();
   for (long s = 0; s < n_slots; ++s) {
     Slot& slot = p->slots[(size_t)s];
     slot.next_fill = s;
-    slot.buffers.resize(p->sources.size());
-    for (size_t a = 0; a < p->sources.size(); ++a) {
-      slot.buffers[a].resize((size_t)(batch_size * p->row_bytes[a]));
+    slot.buffers.resize(n_arrays);
+    for (size_t a = 0; a < n_arrays; ++a) {
+      slot.buffers[a] = static_cast<uint8_t*>(slot_buffers[s * n_arrays + a]);
     }
   }
   if (n_threads < 1) n_threads = 1;
@@ -148,22 +215,20 @@ void upf_start(Prefetcher* p, const long* indices, long n_batches, long batch_si
   }
 }
 
-// Blocks until the next in-order batch is ready; fills out_ptrs with one pointer per
-// source array. Returns the batch index, or -1 when the epoch is exhausted.
-long upf_next(Prefetcher* p, void** out_ptrs) {
+// Blocks until the next in-order batch is ready. Returns the batch index (the
+// consumer reads the python-owned slot buffers directly), or -1 when exhausted.
+long upf_next(Prefetcher* p) {
   if (p->next_deliver >= p->n_batches) return -1;
   long batch = p->next_deliver++;
   Slot& slot = p->slots[batch % (long)p->slots.size()];
   std::unique_lock<std::mutex> lock(p->mu);
   p->cv_ready.wait(lock, [&] { return p->stopping || (slot.ready && slot.batch_idx == batch); });
   if (p->stopping) return -1;
-  for (size_t a = 0; a < slot.buffers.size(); ++a) {
-    out_ptrs[a] = slot.buffers[a].data();
-  }
   return batch;
 }
 
-// Release a delivered batch's slot so workers can refill it.
+// Release a delivered batch's slot so workers can refill it. Call only after the
+// consumer no longer reads the slot buffers (e.g. the device transfer committed).
 void upf_release(Prefetcher* p, long batch) {
   Slot& slot = p->slots[batch % (long)p->slots.size()];
   {

@@ -414,8 +414,8 @@ def flash_attention(
     kv_lens: Optional[jax.Array] = None,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Blocked flash attention (pallas), fully differentiable.
@@ -426,12 +426,27 @@ def flash_attention(
 
     :param kv_lens: optional (batch,) int32 valid KV lengths — the padding-mask case
         (keys at positions >= kv_lens[b] are masked for every head/query of batch b).
+    :param block_q / block_k: Mosaic tile edges; ``None`` resolves through
+        :func:`unionml_tpu.ops.tuning.pick_block_sizes` (measured winners when a
+        ``bench_kernels.py`` sweep has recorded them, aligned defaults otherwise).
     """
+    block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     return _flash_forward(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret)
 
 
+def _resolve_blocks(q, k, block_q, block_k):
+    if block_q is None or block_k is None:
+        from unionml_tpu.ops.tuning import pick_block_sizes
+
+        tuned_q, tuned_k = pick_block_sizes(q.shape[-2], k.shape[-2], q.shape[-1])
+        block_q = block_q if block_q is not None else tuned_q
+        block_k = block_k if block_k is not None else tuned_k
+    return block_q, block_k
+
+
 def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
+    block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     out, lse = _flash_forward(
         q, k, v, kv_lens, causal, scale, block_q, block_k, interpret, return_residuals=True
@@ -443,6 +458,7 @@ def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
     q, k, v, kv_lens, out, lse = residuals
+    block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     if lse is not None:
         dq, dk, dv = _flash_backward(
