@@ -140,6 +140,8 @@ def test_pick_block_sizes_alignment():
     # irregular (non-multiple-of-8) seqs get NON-dividing blocks so the kernel's
     # alignment check routes to the XLA fallback instead of a doomed Mosaic compile
     assert pick_block_sizes(100, 100, 64) == (128, 128)
+    # large multiple-of-8-but-not-128 seqs must NOT become one giant VMEM tile
+    assert pick_block_sizes(1000, 1000, 64) == (128, 128)
     # a measured winner overrides the fallback
     TUNED_BLOCKS[(512, 512, 64)] = (256, 512)
     try:

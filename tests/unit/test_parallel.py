@@ -363,3 +363,92 @@ def test_moe_capacity_validations_and_dtypes():
         moe_apply_capacity(lambda W, t: t @ W, jnp.ones((6, 4, 4)), tokens, jnp.ones((8, 6)), mesh)
     with pytest.raises(ValueError, match="stacked_params carries"):
         moe_apply_capacity(lambda W, t: t @ W, jnp.ones((4, 4, 4)), tokens, gates, mesh)
+
+
+def test_moe_topk_no_drop_matches_dense():
+    """Top-2 dispatch equals the normalized-gate-weighted sum of both experts."""
+    from unionml_tpu.parallel.ep import moe_apply_topk
+
+    rng = np.random.default_rng(2)
+    mesh = make_mesh({"data": 2, "expert": 4})
+    E, D, T = 8, 16, 64
+    eW = jnp.asarray(rng.normal(size=(E, D, 12)) * 0.3, dtype=jnp.float32)
+    tokens = jnp.asarray(rng.normal(size=(T, D)), dtype=jnp.float32)
+    gates = jax.nn.softmax(jnp.asarray(rng.normal(size=(T, E)), dtype=jnp.float32), axis=-1)
+
+    out = jax.jit(
+        lambda eW, tokens, gates: moe_apply_topk(
+            lambda W, t: t @ W, eW, tokens, gates, mesh, k=2, capacity_factor=8.0
+        )
+    )(eW, tokens, gates)
+
+    top_g, top_i = jax.lax.top_k(gates, 2)
+    top_g = top_g / jnp.sum(top_g, axis=-1, keepdims=True)
+    ref = jnp.stack(
+        [
+            top_g[i, 0] * (tokens[i] @ eW[top_i[i, 0]]) + top_g[i, 1] * (tokens[i] @ eW[top_i[i, 1]])
+            for i in range(T)
+        ]
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_moe_topk_first_choices_win_buffer_slots():
+    """Choice-major ordering: under tight capacity no FIRST choice is dropped while
+    a SECOND choice of the same expert survives."""
+    from unionml_tpu.parallel.ep import moe_apply_topk
+
+    rng = np.random.default_rng(3)
+    mesh = make_mesh({"data": 2, "expert": 4})
+    E, D, T = 4, 8, 16
+    eW = jnp.asarray(rng.normal(size=(E, D, D)) * 0.3, dtype=jnp.float32)
+    tokens = jnp.asarray(rng.normal(size=(T, D)), dtype=jnp.float32)
+    # every token's top-1 is expert 0 with weight ~1, top-2 is expert 1
+    logits = np.full((T, E), -10.0, dtype=np.float32)
+    logits[:, 0] = 5.0
+    logits[:, 1] = 2.0
+    gates = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+
+    # capacity = ceil(T*k/E * cf) = 8: tokens 0..7 keep BOTH choices, 8..15 lose both
+    out = np.asarray(
+        moe_apply_topk(lambda W, t: t @ W, eW, tokens, gates, mesh, k=2, capacity_factor=E / 4)
+    )
+    capacity = 8
+    top_g, _ = jax.lax.top_k(gates, 2)
+    g0 = float(top_g[0, 0] / (top_g[0, 0] + top_g[0, 1]))
+    ref_kept = g0 * np.asarray(tokens @ eW[0]) + (1 - g0) * np.asarray(tokens @ eW[1])
+    np.testing.assert_allclose(out[:capacity], ref_kept[:capacity], atol=1e-5)
+    # overflow tokens were dropped from both buffers: exactly zero output
+    np.testing.assert_array_equal(out[capacity:], np.zeros_like(out[capacity:]))
+
+
+def test_moe_topk_grads_flow():
+    from unionml_tpu.parallel.ep import moe_apply_topk
+
+    rng = np.random.default_rng(4)
+    mesh = make_mesh({"data": 2, "expert": 4})
+    eW = jnp.asarray(rng.normal(size=(4, 8, 8)) * 0.3, dtype=jnp.float32)
+    tokens = jnp.asarray(rng.normal(size=(16, 8)), dtype=jnp.float32)
+    gates = jax.nn.softmax(jnp.asarray(rng.normal(size=(16, 4)), dtype=jnp.float32), axis=-1)
+
+    def loss(eW, gates):
+        return jnp.sum(
+            moe_apply_topk(lambda W, t: t @ W, eW, tokens, gates, mesh, k=2, capacity_factor=8.0) ** 2
+        )
+
+    geW, ggates = jax.grad(loss, argnums=(0, 1))(eW, gates)
+    assert float(jnp.sum(jnp.abs(geW))) > 0
+    assert float(jnp.sum(jnp.abs(ggates))) > 0
+
+
+def test_moe_topk_validations():
+    from unionml_tpu.parallel.ep import moe_apply_topk
+
+    mesh = make_mesh({"data": 2, "expert": 4})
+    eW = jnp.ones((8, 4, 4))
+    tokens = jnp.ones((8, 4))
+    gates = jnp.ones((8, 8)) / 8
+    with pytest.raises(ValueError, match="k \\(0\\)"):
+        moe_apply_topk(lambda W, t: t @ W, eW, tokens, gates, mesh, k=0)
+    with pytest.raises(ValueError, match="divisible"):
+        moe_apply_topk(lambda W, t: t @ W, jnp.ones((6, 4, 4)), tokens, jnp.ones((8, 6)) / 6, mesh)

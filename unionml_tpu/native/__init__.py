@@ -121,8 +121,7 @@ def _resolve_conversion(array: np.ndarray, target: Optional[str]) -> Tuple[int, 
         raise ValueError(
             f"Unsupported native conversion {key!r}; supported: {sorted(_CONV_CODES)}"
         )
-    dst = _bfloat16_dtype() if target == "bfloat16" else np.dtype(target)
-    return code, dst
+    return code, target_dtype
 
 
 class PrefetchLoader:

@@ -26,10 +26,6 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-#: default block sizes — multiples of the MXU/VPU tile (128 lanes)
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
-
 _NEG_INF = -1e30
 
 

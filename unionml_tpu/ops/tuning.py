@@ -28,10 +28,12 @@ def _largest_dividing(seq: int, cap: int = 128) -> int:
     for candidate in BLOCK_CANDIDATES:
         if candidate <= cap and seq % candidate == 0:
             return candidate
-    if seq % 8 == 0:
+    if seq <= cap and seq % 8 == 0:
         return seq  # tiny but Mosaic-tileable (sublane multiple): one block
-    # irregular sequence: return a non-dividing block so the kernel's alignment
-    # check routes the call to the XLA fallback instead of a doomed Mosaic compile
+    # irregular or unalignable-at-cap sequences (seq % cap != 0 is guaranteed here —
+    # a dividing cap would have been returned by the candidate loop): return the
+    # non-dividing cap so the kernel's alignment check routes the call to the XLA
+    # fallback instead of a doomed Mosaic compile (or a seq x seq tile over VMEM)
     return cap
 
 

@@ -104,6 +104,46 @@ def moe_apply_capacity(
         the top-1 expert's gate value scales its output (straight-through routing).
     :returns: (tokens, d_out) combined expert outputs.
     """
+    # exactly the k=1 special case of the top-k dispatch: argmax == top_k(1) (both
+    # break ties toward the lower index) and the unnormalized top-1 gate is the
+    # plain gate value — one implementation, one place to fix routing bugs
+    return moe_apply_topk(
+        expert_fn,
+        stacked_params,
+        tokens,
+        gates,
+        mesh,
+        k=1,
+        capacity_factor=capacity_factor,
+        normalize_gates=False,
+        axis=axis,
+    )
+
+
+def moe_apply_topk(
+    expert_fn: Callable,
+    stacked_params: Any,
+    tokens: jax.Array,
+    gates: jax.Array,
+    mesh: Mesh,
+    *,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    normalize_gates: bool = True,
+    axis: str = EXPERT_AXIS,
+) -> jax.Array:
+    """GShard top-k (default top-2) capacity-based MoE dispatch.
+
+    Generalizes :func:`moe_apply_capacity` to k routed experts per token: each token
+    claims up to ``k`` expert-buffer slots, choice-major — every token's FIRST choice
+    is assigned buffer positions before any second choice, so overflow drops lower-
+    priority choices first (the GShard ordering). Combined output is the gate-weighted
+    sum over surviving choices; ``normalize_gates`` renormalizes over the top-k
+    (the standard top-2 formulation).
+
+    Expert buffers carry ``axis`` sharding constraints, so under ``jit`` XLA inserts
+    the all-to-alls that move only each expert's tokens to its device.
+    """
     num_tokens, num_experts = gates.shape
     axis_size = mesh.shape[axis]
     params_experts = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
@@ -115,23 +155,30 @@ def moe_apply_capacity(
         raise ValueError(
             f"num_experts ({num_experts}) must be divisible by the {axis!r} axis size ({axis_size})"
         )
-    capacity = int(np.ceil(num_tokens / num_experts * capacity_factor))
+    if not 1 <= k <= num_experts:
+        raise ValueError(f"k ({k}) must be in [1, num_experts={num_experts}]")
+    capacity = int(np.ceil(num_tokens * k / num_experts * capacity_factor))
     capacity = max(capacity, 1)
 
-    expert_index = jnp.argmax(gates, axis=-1)  # (t,)
-    gate_value = jnp.take_along_axis(gates, expert_index[:, None], axis=-1)[:, 0]  # (t,)
-    # count buffer positions in int32: counting in a low-precision activation dtype
-    # (bf16) silently corrupts routing past 256 tokens per expert
-    expert_one_hot_i = jax.nn.one_hot(expert_index, num_experts, dtype=jnp.int32)  # (t, e)
-    position_in_expert = jnp.sum(
-        (jnp.cumsum(expert_one_hot_i, axis=0) - expert_one_hot_i) * expert_one_hot_i, axis=-1
-    )  # (t,)
+    top_gates, top_index = jax.lax.top_k(gates, k)  # (t, k)
+    if normalize_gates:
+        top_gates = top_gates / jnp.maximum(jnp.sum(top_gates, axis=-1, keepdims=True), 1e-9)
 
-    # (t, e, c) dispatch tensor; one_hot zeroes out-of-range positions, which IS the
-    # capacity drop (tokens with position >= capacity get an all-zero row)
-    expert_one_hot = expert_one_hot_i.astype(tokens.dtype)
-    position_one_hot = jax.nn.one_hot(position_in_expert, capacity, dtype=tokens.dtype)  # (t, c)
-    dispatch = expert_one_hot[:, :, None] * position_one_hot[:, None, :]
+    # choice-major position assignment: flatten to (k * t, e) with choice 0 first so
+    # first choices never lose a buffer slot to someone's second choice (int32: a
+    # low-precision cumsum would corrupt routing past 256 tokens per expert)
+    one_hot_i = jax.nn.one_hot(top_index, num_experts, dtype=jnp.int32)  # (t, k, e)
+    choice_major = jnp.swapaxes(one_hot_i, 0, 1).reshape(k * num_tokens, num_experts)
+    positions_flat = jnp.sum(
+        (jnp.cumsum(choice_major, axis=0) - choice_major) * choice_major, axis=-1
+    )  # (k * t,)
+    position = jnp.swapaxes(positions_flat.reshape(k, num_tokens), 0, 1)  # (t, k)
+
+    # (t, e, c) dispatch/combine: one_hot zeroes positions >= capacity (the drop)
+    one_hot = one_hot_i.astype(tokens.dtype)  # (t, k, e)
+    position_one_hot = jax.nn.one_hot(position, capacity, dtype=tokens.dtype)  # (t, k, c)
+    dispatch = jnp.einsum("tke,tkc->tec", one_hot, position_one_hot)
+    combine = jnp.einsum("tke,tkc,tk->tec", one_hot, position_one_hot, top_gates.astype(tokens.dtype))
 
     expert_inputs = jnp.einsum("tec,td->ecd", dispatch, tokens)  # (e, c, d)
     expert_inputs = jax.lax.with_sharding_constraint(
@@ -142,6 +189,5 @@ def moe_apply_capacity(
         expert_outputs, NamedSharding(mesh, P(axis, None, None))
     )
 
-    combine = dispatch * gate_value.astype(tokens.dtype)[:, None, None]
     out = jnp.einsum("tec,ecd->td", combine, expert_outputs.astype(tokens.dtype))
-    return out.astype(tokens.dtype)  # keep moe_apply's output-dtype contract
+    return out.astype(tokens.dtype)
