@@ -67,6 +67,34 @@ def _install_device_watchdog():
     return ready
 
 
+#: peak dense bf16 TFLOP/s per chip for MFU accounting (public spec sheets).
+#: Keys match jax device_kind with spaces stripped — real strings look like
+#: "TPU v5 lite" / "TPU v5p" / "TPU v4"; order matters (most specific first).
+_CHIP_PEAK_TFLOPS = (
+    ("v5lite", 197.0),  # v5e reports device_kind "TPU v5 lite"
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v6lite", 918.0),  # v6e / Trillium
+    ("v6e", 918.0),
+    ("v4", 275.0),
+)
+
+
+def _chip_peak_flops():
+    """Peak FLOP/s of the local chip, or None when unknown (logged)."""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    except Exception:
+        return None
+    for name, tflops in _CHIP_PEAK_TFLOPS:
+        if name in kind:
+            return tflops * 1e12
+    print(f"[bench] unrecognized device_kind {kind!r}: MFU omitted.", file=sys.stderr)
+    return None
+
+
 def run_bench():
     ready = _install_device_watchdog()
 
@@ -135,13 +163,18 @@ def run_bench():
             tokens_per_s = examples_per_s * seq_len
             flops_per_token = bert_flops_per_token(config)
             achieved_flops = tokens_per_s * flops_per_token
+            mfu = None
+            peak = _chip_peak_flops()
+            if on_accelerator and peak:
+                mfu = achieved_flops / peak
             print(
                 f"[bench] backend={backend} batch={batch_size} steps={measure_steps} "
                 f"elapsed={elapsed:.2f}s examples/s={examples_per_s:.1f} "
-                f"tokens/s={tokens_per_s:.0f} ~TFLOP/s={achieved_flops/1e12:.2f}",
+                f"tokens/s={tokens_per_s:.0f} ~TFLOP/s={achieved_flops/1e12:.2f}"
+                + (f" MFU={mfu:.1%}" if mfu is not None else ""),
                 file=sys.stderr,
             )
-            return examples_per_s
+            return examples_per_s, mfu
         except Exception as exc:  # OOM etc: try a smaller batch
             last_error = exc
             print(f"[bench] batch={batch_size} failed: {exc}", file=sys.stderr)
@@ -149,19 +182,18 @@ def run_bench():
 
 
 def main():
-    value = run_bench()
+    value, mfu = run_bench()
     vs_baseline = value / BASELINE_EXAMPLES_PER_S if BASELINE_EXAMPLES_PER_S else 1.0
+    payload = {
+        "metric": "bert_base_finetune_throughput",
+        "value": round(value, 2),
+        "unit": "examples/s",
+        "vs_baseline": round(vs_baseline, 3),
+    }
+    if mfu is not None:
+        payload["mfu"] = round(mfu, 4)
     with _OUTPUT_LOCK:
-        print(
-            json.dumps(
-                {
-                    "metric": "bert_base_finetune_throughput",
-                    "value": round(value, 2),
-                    "unit": "examples/s",
-                    "vs_baseline": round(vs_baseline, 3),
-                }
-            )
-        )
+        print(json.dumps(payload))
 
 
 if __name__ == "__main__":

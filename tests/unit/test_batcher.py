@@ -140,3 +140,73 @@ def test_close_fails_queued_requests_instead_of_hanging():
     # the in-flight request either completes or fails cleanly; the queued one must fail
     assert isinstance(second_result, Exception) or second_result == [2]
     assert not isinstance(first_result, asyncio.CancelledError)
+
+
+def test_adaptive_wait_skips_straggler_window_when_sparse():
+    """Sparse traffic: the EMA gap exceeds max_wait, so the window collapses to 0."""
+    batcher = RequestBatcher(lambda rows: rows, max_batch=8, max_wait_ms=2.0, adaptive=True)
+    assert batcher._effective_wait_s() == batcher.max_wait_s  # no history yet: default
+    batcher._ema_gap_s = 0.5  # 500ms between requests >> 2ms window
+    assert batcher._effective_wait_s() == 0.0
+    batcher._ema_gap_s = 0.0005  # bursty: 0.5ms gaps
+    assert batcher._effective_wait_s() == batcher.max_wait_s
+    batcher.adaptive = False
+    batcher._ema_gap_s = 0.5
+    assert batcher._effective_wait_s() == batcher.max_wait_s
+
+
+def test_adaptive_burst_still_coalesces():
+    """Concurrent requests under adaptive mode still merge into shared calls."""
+    calls = []
+
+    def predict(rows):
+        calls.append(len(rows))
+        return [r * 2 for r in rows]
+
+    async def scenario():
+        batcher = RequestBatcher(predict, max_batch=16, max_wait_ms=20.0, adaptive=True)
+        batcher._ema_gap_s = 0.001  # dense traffic observed
+        results = await asyncio.gather(*[batcher.submit([i]) for i in range(6)])
+        batcher.close()
+        return results
+
+    results = asyncio.run(scenario())
+    assert [r[0] for r in results] == [0, 2, 4, 6, 8, 10]
+    assert max(calls) > 1  # at least one genuinely coalesced call
+
+
+def test_burst_after_idle_still_coalesces():
+    """Review regression: zero-wait mode must still drain already-queued requests."""
+    calls = []
+
+    def predict(rows):
+        calls.append(len(rows))
+        return [r * 2 for r in rows]
+
+    async def scenario():
+        batcher = RequestBatcher(predict, max_batch=16, max_wait_ms=2.0, adaptive=True)
+        batcher._ema_gap_s = 10.0  # long-idle EMA: effective wait is zero
+        assert batcher._effective_wait_s() == 0.0
+        # enqueue a burst BEFORE the worker drains: all should share one call
+        batcher._ensure_worker()
+        futures = [asyncio.ensure_future(batcher.submit([i])) for i in range(6)]
+        await asyncio.sleep(0)  # let all submits enqueue before the worker runs
+        results = await asyncio.gather(*futures)
+        batcher.close()
+        return results
+
+    results = asyncio.run(scenario())
+    assert [r[0] for r in results] == [0, 2, 4, 6, 8, 10]
+    assert max(calls) > 1, f"burst was not coalesced: calls={calls}"
+
+
+def test_idle_gap_is_clamped_in_ema():
+    async def scenario():
+        batcher = RequestBatcher(lambda rows: rows, max_batch=8, max_wait_ms=2.0)
+        batcher._last_arrival = asyncio.get_running_loop().time() - 60.0  # 60s idle
+        await batcher.submit([1])
+        batcher.close()
+        return batcher._ema_gap_s
+
+    ema = asyncio.run(scenario())
+    assert ema <= 10 * 0.002 + 1e-9  # clamped to 10x the wait window, not 60s

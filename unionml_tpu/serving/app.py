@@ -185,6 +185,8 @@ def build_aiohttp_app(
         payload = {"model": model.name, "resident": predictor is not None}
         if batcher is not None:
             payload["coalescing"] = dict(batcher.stats)
+            if batcher.ema_gap_ms is not None:
+                payload["coalescing"]["ema_gap_ms"] = round(batcher.ema_gap_ms, 3)
         return web.json_response(payload)
 
     app.router.add_get("/", index)

@@ -25,10 +25,20 @@ class RequestBatcher:
         predict_rows: Callable[[List[Any]], Sequence[Any]],
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
+        adaptive: bool = True,
     ):
+        """
+        ``adaptive=True`` keys the straggler wait on the observed arrival rate: when
+        requests arrive sparsely (EMA inter-arrival gap above ``max_wait_ms``),
+        waiting would add latency and coalesce nothing, so batches flush
+        immediately; under bursts the full ``max_wait_ms`` window applies.
+        """
         self._predict_rows = predict_rows
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
+        self.adaptive = adaptive
+        self._ema_gap_s: Optional[float] = None
+        self._last_arrival: Optional[float] = None
         self._queue: Optional[asyncio.Queue] = None
         self._worker: Optional[asyncio.Task] = None
         self.stats = {"requests": 0, "rows": 0, "batches": 0}
@@ -42,21 +52,51 @@ class RequestBatcher:
     async def submit(self, rows: List[Any]) -> List[Any]:
         """Queue one request's rows; resolves with that request's predictions."""
         self._ensure_worker()
+        now = asyncio.get_running_loop().time()
+        if self._last_arrival is not None:
+            # clamp: one long idle period must not poison the EMA for the burst
+            # that follows it (recovery would otherwise take dozens of requests)
+            gap = min(now - self._last_arrival, 10 * self.max_wait_s)
+            self._ema_gap_s = gap if self._ema_gap_s is None else 0.8 * self._ema_gap_s + 0.2 * gap
+        self._last_arrival = now
         future = asyncio.get_running_loop().create_future()
         self.stats["requests"] += 1
         self.stats["rows"] += len(rows)
         await self._queue.put((rows, future))
         return await future
 
+    @property
+    def ema_gap_ms(self) -> Optional[float]:
+        """Observed EMA inter-arrival gap (ms); None before any traffic."""
+        return None if self._ema_gap_s is None else self._ema_gap_s * 1e3
+
+    def _effective_wait_s(self) -> float:
+        """The straggler window for this batch under the adaptive policy."""
+        if not self.adaptive or self._ema_gap_s is None:
+            return self.max_wait_s
+        if self._ema_gap_s > self.max_wait_s:
+            return 0.0  # sparse traffic: waiting only adds latency
+        return self.max_wait_s
+
     async def _run(self) -> None:
         while True:
             rows, future = await self._queue.get()
             pending = [(rows, future)]
             total = len(rows)
-            deadline = asyncio.get_running_loop().time() + self.max_wait_s
+            deadline = asyncio.get_running_loop().time() + self._effective_wait_s()
             while total < self.max_batch:
                 timeout = deadline - asyncio.get_running_loop().time()
                 if timeout <= 0:
+                    # window spent (or adaptive zero-wait): still drain whatever is
+                    # ALREADY queued — simultaneous arrivals must coalesce even when
+                    # the straggler wait is zero
+                    while total < self.max_batch:
+                        try:
+                            more_rows, more_future = self._queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        pending.append((more_rows, more_future))
+                        total += len(more_rows)
                     break
                 try:
                     more_rows, more_future = await asyncio.wait_for(self._queue.get(), timeout)
