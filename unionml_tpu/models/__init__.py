@@ -22,6 +22,12 @@ from unionml_tpu.models.gpt import init_cache as init_gpt_cache
 from unionml_tpu.models.gpt import init_params as init_gpt_params
 from unionml_tpu.models.gpt import lm_loss as gpt_lm_loss
 from unionml_tpu.models.mlp import CNNClassifier, MLPClassifier
+from unionml_tpu.models.moe import (
+    MoEMlp,
+    collect_aux_losses,
+    load_balancing_loss,
+    router_z_loss,
+)
 from unionml_tpu.models.training import (
     FitResult,
     TrainState,
@@ -38,6 +44,10 @@ __all__ = [
     "BertModel",
     "CNNClassifier",
     "FitResult",
+    "MoEMlp",
+    "collect_aux_losses",
+    "load_balancing_loss",
+    "router_z_loss",
     "GPTConfig",
     "GPTLMHeadModel",
     "MLPClassifier",

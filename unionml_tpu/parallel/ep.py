@@ -9,7 +9,7 @@ semantic change; queued as future work in NEXT.md).
 """
 
 import functools
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -125,7 +125,7 @@ def moe_apply_topk(
     stacked_params: Any,
     tokens: jax.Array,
     gates: jax.Array,
-    mesh: Mesh,
+    mesh: Optional[Mesh] = None,
     *,
     k: int = 2,
     capacity_factor: float = 1.25,
@@ -141,19 +141,21 @@ def moe_apply_topk(
     sum over surviving choices; ``normalize_gates`` renormalizes over the top-k
     (the standard top-2 formulation).
 
-    Expert buffers carry ``axis`` sharding constraints, so under ``jit`` XLA inserts
-    the all-to-alls that move only each expert's tokens to its device.
+    With a ``mesh``, expert buffers carry ``axis`` sharding constraints, so under
+    ``jit`` XLA inserts the all-to-alls that move only each expert's tokens to its
+    device; ``mesh=None`` runs the same dispatch unsharded (single-device layers,
+    e.g. :class:`unionml_tpu.models.moe.MoEMlp` without expert parallelism).
     """
     num_tokens, num_experts = gates.shape
-    axis_size = mesh.shape[axis]
     params_experts = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if params_experts != num_experts:
         raise ValueError(
             f"gates are over {num_experts} experts but stacked_params carries {params_experts}"
         )
-    if num_experts % axis_size:
+    if mesh is not None and num_experts % mesh.shape[axis]:
         raise ValueError(
-            f"num_experts ({num_experts}) must be divisible by the {axis!r} axis size ({axis_size})"
+            f"num_experts ({num_experts}) must be divisible by the {axis!r} axis size "
+            f"({mesh.shape[axis]})"
         )
     if not 1 <= k <= num_experts:
         raise ValueError(f"k ({k}) must be in [1, num_experts={num_experts}]")
@@ -181,13 +183,15 @@ def moe_apply_topk(
     combine = jnp.einsum("tke,tkc,tk->tec", one_hot, position_one_hot, top_gates.astype(tokens.dtype))
 
     expert_inputs = jnp.einsum("tec,td->ecd", dispatch, tokens)  # (e, c, d)
-    expert_inputs = jax.lax.with_sharding_constraint(
-        expert_inputs, NamedSharding(mesh, P(axis, None, None))
-    )
+    if mesh is not None:
+        expert_inputs = jax.lax.with_sharding_constraint(
+            expert_inputs, NamedSharding(mesh, P(axis, None, None))
+        )
     expert_outputs = jax.vmap(expert_fn)(stacked_params, expert_inputs)  # (e, c, d_out)
-    expert_outputs = jax.lax.with_sharding_constraint(
-        expert_outputs, NamedSharding(mesh, P(axis, None, None))
-    )
+    if mesh is not None:
+        expert_outputs = jax.lax.with_sharding_constraint(
+            expert_outputs, NamedSharding(mesh, P(axis, None, None))
+        )
 
     out = jnp.einsum("tec,ecd->td", combine, expert_outputs.astype(tokens.dtype))
     return out.astype(tokens.dtype)
