@@ -452,3 +452,47 @@ def test_moe_topk_validations():
         moe_apply_topk(lambda W, t: t @ W, eW, tokens, gates, mesh, k=0)
     with pytest.raises(ValueError, match="divisible"):
         moe_apply_topk(lambda W, t: t @ W, jnp.ones((6, 4, 4)), tokens, jnp.ones((8, 6)) / 6, mesh)
+
+
+def test_superstage_deep_model_pipelines():
+    """12 layers on a 4-deep stage axis: superstages match sequential application."""
+    from unionml_tpu.parallel.pp import pipeline_apply, superstage
+
+    rng = np.random.default_rng(5)
+    mesh = make_mesh({"data": 2, "stage": 4})
+    L, width, batch = 12, 8, 16
+    Ws = jnp.asarray(rng.normal(size=(L, width, width)) * 0.2, dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(batch, width)), dtype=jnp.float32)
+
+    def layer_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    stage_fn, stage_params = superstage(layer_fn, Ws, num_stages=4)
+    # scanned superstages must run under jit (lax.scan inside shard_map)
+    out = jax.jit(
+        lambda sp, x: pipeline_apply(stage_fn, sp, x, mesh, num_microbatches=4)
+    )(stage_params, x)
+
+    ref = x
+    for layer in range(L):
+        ref = layer_fn(Ws[layer], ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    # gradients flow through the scanned superstages too
+    @jax.jit
+    def loss(Ws):
+        fn, sp = superstage(layer_fn, Ws, num_stages=4)
+        return jnp.sum(pipeline_apply(fn, sp, x, mesh, num_microbatches=4, remat=True) ** 2)
+
+    def loss_seq(Ws):
+        h = x
+        for layer in range(L):
+            h = layer_fn(Ws[layer], h)
+        return jnp.sum(h ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss)(Ws)), np.asarray(jax.grad(loss_seq)(Ws)), atol=1e-4
+    )
+
+    with pytest.raises(ValueError, match="divisible"):
+        superstage(layer_fn, Ws, num_stages=5)

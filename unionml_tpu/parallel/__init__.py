@@ -7,7 +7,7 @@ collectives — the TPU-native replacement for an NCCL/MPI backend (SURVEY.md §
 
 from unionml_tpu.parallel.dp import batches, data_parallel_eval, data_parallel_step, pad_to_multiple
 from unionml_tpu.parallel.ep import expert_sharding, moe_apply, moe_apply_capacity, moe_apply_topk
-from unionml_tpu.parallel.pp import pipeline_apply, stage_sharding
+from unionml_tpu.parallel.pp import superstage, pipeline_apply, stage_sharding
 from unionml_tpu.parallel.ring import ring_attention, sequence_sharding
 from unionml_tpu.parallel.ulysses import ulysses_attention
 from unionml_tpu.parallel.mesh import (
@@ -40,6 +40,7 @@ __all__ = [
     "moe_apply_capacity",
     "moe_apply_topk",
     "pipeline_apply",
+    "superstage",
     "stage_sharding",
     "make_hybrid_mesh",
     "make_mesh",

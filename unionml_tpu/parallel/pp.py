@@ -152,3 +152,42 @@ def pipeline_apply(
 def stage_sharding(mesh: Mesh, axis: str = STAGE_AXIS) -> NamedSharding:
     """Sharding for stacked per-stage parameters (leading stage axis)."""
     return NamedSharding(mesh, P(axis))
+
+
+def superstage(layer_fn: Callable, stacked_layer_params: Any, num_stages: int):
+    """Group L stacked layers into ``num_stages`` pipeline superstages.
+
+    Deep models usually have more layers than pipeline devices (BERT-base: 12 layers
+    on a 4-deep stage axis). This helper blocks consecutive layers onto one device —
+    stage s owns layers ``[s*c, s*c + c)`` with ``c = L / num_stages`` — and returns
+    ``(stage_fn, stage_params)`` ready for :func:`pipeline_apply`: the stage body
+    scans its ``c`` layers sequentially (one fused superstage per tick, bubble
+    fraction unchanged at ``(S-1)/(M+S-1)``).
+
+    :param layer_fn: ``(layer_params, h) -> h`` for ONE layer.
+    :param stacked_layer_params: pytree with leading axis L (all layers stacked).
+    :returns: ``(stage_fn, stage_params)`` where stage_params carries a leading
+        ``num_stages`` axis and stage_fn applies the local layer block via
+        ``lax.scan`` (compiler-friendly; no per-layer retrace). Because the stage
+        body contains a scan, the surrounding :func:`pipeline_apply` call must run
+        under ``jax.jit`` (the normal train-step pattern).
+    """
+    leaves = jax.tree_util.tree_leaves(stacked_layer_params)
+    num_layers = leaves[0].shape[0]
+    if num_layers % num_stages:
+        raise ValueError(
+            f"num_layers ({num_layers}) must be divisible by num_stages ({num_stages})"
+        )
+    per_stage = num_layers // num_stages
+    stage_params = jax.tree_util.tree_map(
+        lambda p: p.reshape((num_stages, per_stage) + p.shape[1:]), stacked_layer_params
+    )
+
+    def stage_fn(params, h):
+        def body(carry, layer_params):
+            return layer_fn(layer_params, carry), None
+
+        out, _ = lax.scan(body, h, params)
+        return out
+
+    return stage_fn, stage_params
