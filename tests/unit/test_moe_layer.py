@@ -74,3 +74,28 @@ def test_moe_mlp_expert_sharded_on_mesh():
     params = layer.init(jax.random.PRNGKey(0), x)
     out = jax.jit(lambda p, x: layer.apply(p, x))(params, x)
     assert out.shape == x.shape
+
+
+def test_dropless_mode_never_drops_under_imbalance():
+    """Review regression: with a fully-collapsed router, capacity mode drops tokens
+    but dropless mode matches the dense per-token computation exactly."""
+    from unionml_tpu.parallel.ep import moe_apply_topk
+
+    rng = np.random.default_rng(6)
+    E, D, T = 4, 8, 32
+    eW = jnp.asarray(rng.normal(size=(E, D, D)) * 0.3, dtype=jnp.float32)
+    tokens = jnp.asarray(rng.normal(size=(T, D)), dtype=jnp.float32)
+    logits = np.full((T, E), -10.0, dtype=np.float32)
+    logits[:, 0] = 5.0  # every token's top-1 collapses onto expert 0
+    logits[:, 1] = 2.0
+    gates = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+
+    top_g, _ = jax.lax.top_k(gates, 2)
+    g = top_g / jnp.sum(top_g, axis=-1, keepdims=True)
+    ref = g[:, :1] * (tokens @ eW[0]) + g[:, 1:2] * (tokens @ eW[1])
+
+    dropless = moe_apply_topk(lambda W, t: t @ W, eW, tokens, gates, k=2, capacity_factor=None)
+    np.testing.assert_allclose(np.asarray(dropless), np.asarray(ref), atol=1e-5)
+
+    capped = moe_apply_topk(lambda W, t: t @ W, eW, tokens, gates, k=2, capacity_factor=1.0)
+    assert np.abs(np.asarray(capped) - np.asarray(ref)).max() > 1e-3  # drops happened

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from unionml_tpu.models.moe import MoEMlp
 from unionml_tpu.ops.attention import attention, xla_attention
 
 
@@ -33,6 +34,14 @@ class GPTConfig:
     dropout: float = 0.1
     dtype: Any = jnp.bfloat16
     attention_impl: str = "auto"
+    #: sparse (mixture-of-experts) variant: every Nth block swaps its dense MLP for
+    #: a routed :class:`unionml_tpu.models.moe.MoEMlp` (0 = fully dense). Router
+    #: aux losses sow under "intermediates" — fold them into the training loss with
+    #: :func:`unionml_tpu.models.moe.collect_aux_losses`.
+    moe_every: int = 0
+    num_experts: int = 8
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def tiny(cls, **overrides) -> "GPTConfig":
@@ -49,6 +58,7 @@ class GPTConfig:
 
 class DecoderBlock(nn.Module):
     config: GPTConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, hidden, cache: Optional[Dict[str, jax.Array]], position, deterministic: bool):
@@ -94,9 +104,23 @@ class DecoderBlock(nn.Module):
         hidden = hidden + attn_out
 
         normed = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="mlp_norm")(hidden)
-        up = nn.Dense(4 * cfg.hidden_size, dtype=cfg.dtype, name="mlp_up")(normed)
-        up = nn.gelu(up, approximate=True)
-        down = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_down")(up)
+        if self.use_moe:
+            # deterministic (eval/generate) disables the capacity drop: a trained,
+            # imbalanced router must not silently zero overflow tokens at inference,
+            # and capacity depends on the per-call token count, which differs
+            # between prefill, decode steps, and full forwards
+            down = MoEMlp(
+                num_experts=cfg.num_experts,
+                hidden_size=4 * cfg.hidden_size,
+                k=cfg.moe_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype,
+                name="moe_mlp",
+            )(normed, dropless=deterministic)
+        else:
+            up = nn.Dense(4 * cfg.hidden_size, dtype=cfg.dtype, name="mlp_up")(normed)
+            up = nn.gelu(up, approximate=True)
+            down = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_down")(up)
         down = nn.Dropout(cfg.dropout)(down, deterministic=deterministic)
         return hidden + down, new_cache
 
@@ -129,7 +153,8 @@ class GPTLMHeadModel(nn.Module):
         new_cache: Dict[str, Any] = {}
         for i in range(cfg.num_layers):
             layer_cache = None if cache is None else cache[f"layer_{i}"]
-            hidden, layer_cache = DecoderBlock(cfg, name=f"layer_{i}")(
+            use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+            hidden, layer_cache = DecoderBlock(cfg, use_moe=use_moe, name=f"layer_{i}")(
                 hidden, layer_cache, position, deterministic
             )
             if layer_cache is not None:

@@ -67,7 +67,9 @@ class MoEMlp(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, dropless: bool = False) -> jax.Array:
+        """``dropless=True`` disables the capacity drop (inference parity: a trained,
+        imbalanced router must not silently zero overflow tokens during decode)."""
         d_model = x.shape[-1]
         tokens = x.reshape(-1, d_model)
 
@@ -107,7 +109,7 @@ class MoEMlp(nn.Module):
             gates.astype(self.dtype),
             self.mesh,
             k=self.k,
-            capacity_factor=self.capacity_factor,
+            capacity_factor=None if dropless else self.capacity_factor,
         )
         return out.reshape(x.shape).astype(x.dtype)
 

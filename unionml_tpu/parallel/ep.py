@@ -128,11 +128,16 @@ def moe_apply_topk(
     mesh: Optional[Mesh] = None,
     *,
     k: int = 2,
-    capacity_factor: float = 1.25,
+    capacity_factor: Optional[float] = 1.25,
     normalize_gates: bool = True,
     axis: str = EXPERT_AXIS,
 ) -> jax.Array:
     """GShard top-k (default top-2) capacity-based MoE dispatch.
+
+    ``capacity_factor=None`` is DROPLESS: every expert's buffer holds all tokens
+    (position < num_tokens always), so no token ever loses a routed choice —
+    the inference-parity mode (E x num_tokens buffer memory; use the factor-bounded
+    mode for training efficiency).
 
     Generalizes :func:`moe_apply_capacity` to k routed experts per token: each token
     claims up to ``k`` expert-buffer slots, choice-major — every token's FIRST choice
@@ -159,8 +164,10 @@ def moe_apply_topk(
         )
     if not 1 <= k <= num_experts:
         raise ValueError(f"k ({k}) must be in [1, num_experts={num_experts}]")
-    capacity = int(np.ceil(num_tokens * k / num_experts * capacity_factor))
-    capacity = max(capacity, 1)
+    if capacity_factor is None:
+        capacity = num_tokens  # dropless: the worst-case routing fits
+    else:
+        capacity = max(int(np.ceil(num_tokens * k / num_experts * capacity_factor)), 1)
 
     top_gates, top_index = jax.lax.top_k(gates, k)  # (t, k)
     if normalize_gates:
