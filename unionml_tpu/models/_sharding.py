@@ -1,0 +1,21 @@
+"""Shared machinery for rule-based parameter sharding tables.
+
+Each model family (BERT encoder, GPT decoder) declares only its ``spec_for`` rule
+function; the path flattening / key normalization / tree reconstruction live here so
+a fix for new jax key types lands once for every family.
+"""
+
+from typing import Any, Callable, Tuple
+
+import jax
+
+
+def shard_by_rules(params: Any, spec_for: Callable[[Tuple[str, ...], Any], Any]) -> Any:
+    """Apply ``spec_for((path parts), leaf) -> PartitionSpec`` over a parameter tree."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    specs = [
+        spec_for(tuple(getattr(k, "key", getattr(k, "idx", k)) for k in path), leaf)
+        for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, specs)

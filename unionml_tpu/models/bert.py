@@ -254,13 +254,9 @@ def param_shardings(params: Any, mesh_axis_names: Tuple[str, ...] = (DATA_AXIS, 
             return P(fsdp, None)
         return P()
 
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    treedef = jax.tree_util.tree_structure(params)
-    specs = [
-        spec_for(tuple(getattr(k, "key", getattr(k, "idx", k)) for k in path), leaf)
-        for path, leaf in flat
-    ]
-    return jax.tree_util.tree_unflatten(treedef, specs)
+    from unionml_tpu.models._sharding import shard_by_rules
+
+    return shard_by_rules(params, spec_for)
 
 
 # ---------------------------------------------------------------------- HF import

@@ -256,3 +256,47 @@ def lm_loss(logits: jax.Array, input_ids: jax.Array, mask: Optional[jax.Array] =
     targets = input_ids[:, 1:]
     weights = None if mask is None else mask[:, 1:]
     return cross_entropy_with_integer_labels(shifted_logits, targets, weights)
+
+
+def param_shardings(params: Any, mesh_axis_names: Tuple[str, ...] = ("data", "tensor")) -> Any:
+    """PartitionSpec tree for the GPT parameter pytree (Megatron-style split).
+
+    Mirrors :func:`unionml_tpu.models.bert.param_shardings` for the decoder family:
+
+    - fused qkv kernel and MLP up-projection: shard the OUTPUT dim over ``tensor``
+    - attention output and MLP down-projection: shard the INPUT dim over ``tensor``
+    - token/position embeddings: shard the vocab/position dim over ``tensor``
+    - MoE expert kernels (E, d, h)/(E, h, d): expert dim over ``expert`` when that
+      axis exists, inner dims Megatron-split like the dense MLP
+    - everything else replicated, or FSDP-sharded over ``fsdp`` when present
+
+    XLA inserts the matching all-reduces over ICI; nothing else is needed.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    tensor = "tensor" if "tensor" in mesh_axis_names else None
+    fsdp = "fsdp" if "fsdp" in mesh_axis_names else None
+    expert = "expert" if "expert" in mesh_axis_names else None
+
+    def spec_for(path: Tuple[str, ...], leaf) -> P:
+        path_str = "/".join(str(p) for p in path)
+        ndim = getattr(leaf, "ndim", 0)
+        if "w_in" in path_str and ndim == 3:
+            return P(expert, fsdp, tensor)
+        if "w_out" in path_str and ndim == 3:
+            return P(expert, tensor, fsdp)
+        if ndim < 2:
+            return P()
+        if ("wte" in path_str or "wpe" in path_str) and "embedding" in path_str:
+            return P(tensor, None)
+        if ("qkv" in path_str or "mlp_up" in path_str) and path_str.endswith("kernel"):
+            return P(fsdp, tensor)
+        if ("attn_out" in path_str or "mlp_down" in path_str) and path_str.endswith("kernel"):
+            return P(tensor, fsdp)
+        if path_str.endswith("kernel"):
+            return P(fsdp, None)
+        return P()
+
+    from unionml_tpu.models._sharding import shard_by_rules
+
+    return shard_by_rules(params, spec_for)

@@ -134,10 +134,11 @@ def moe_apply_topk(
 ) -> jax.Array:
     """GShard top-k (default top-2) capacity-based MoE dispatch.
 
-    ``capacity_factor=None`` is DROPLESS: every expert's buffer holds all tokens
-    (position < num_tokens always), so no token ever loses a routed choice —
-    the inference-parity mode (E x num_tokens buffer memory; use the factor-bounded
-    mode for training efficiency).
+    ``capacity_factor=None`` is DROPLESS: the dispatch switches to the dense-masked
+    formulation (every expert computes every token, top-k gates select) so no token
+    ever loses a routed choice regardless of router imbalance — the inference-parity
+    mode. Costs E x redundant expert compute; use the factor-bounded mode for
+    training efficiency.
 
     Generalizes :func:`moe_apply_capacity` to k routed experts per token: each token
     claims up to ``k`` expert-buffer slots, choice-major — every token's FIRST choice
@@ -164,14 +165,27 @@ def moe_apply_topk(
         )
     if not 1 <= k <= num_experts:
         raise ValueError(f"k ({k}) must be in [1, num_experts={num_experts}]")
-    if capacity_factor is None:
-        capacity = num_tokens  # dropless: the worst-case routing fits
-    else:
-        capacity = max(int(np.ceil(num_tokens * k / num_experts * capacity_factor)), 1)
 
     top_gates, top_index = jax.lax.top_k(gates, k)  # (t, k)
     if normalize_gates:
         top_gates = top_gates / jnp.maximum(jnp.sum(top_gates, axis=-1, keepdims=True), 1e-9)
+
+    if capacity_factor is None:
+        # dropless via the dense-masked formulation (same shape as _moe_local):
+        # every expert computes every token — E x redundant compute, O(E * T * d)
+        # memory — and the top-k gates select/weight per token. Exact for any
+        # router state; far cheaper than capacity=num_tokens buffers (O(E * T^2)).
+        all_out = jax.vmap(expert_fn, in_axes=(0, None))(stacked_params, tokens)  # (e, t, d_out)
+        if mesh is not None:
+            all_out = jax.lax.with_sharding_constraint(
+                all_out, NamedSharding(mesh, P(axis, None, None))
+            )
+        one_hot_k = jax.nn.one_hot(top_index, num_experts, dtype=tokens.dtype)  # (t, k, e)
+        weights = jnp.einsum("tke,tk->te", one_hot_k, top_gates.astype(tokens.dtype))
+        out = jnp.einsum("te,etd->td", weights, all_out.astype(tokens.dtype))
+        return out.astype(tokens.dtype)
+
+    capacity = max(int(np.ceil(num_tokens * k / num_experts * capacity_factor)), 1)
 
     # choice-major position assignment: flatten to (k * t, e) with choice 0 first so
     # first choices never lose a buffer slot to someone's second choice (int32: a
