@@ -1,0 +1,144 @@
+"""Checkpoint subsystem: pytree round-trips, orbax step resume, sharded restore,
+and SIGTERM preemption flush (SURVEY.md §5 checkpoint/resume obligations)."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.checkpoint import Checkpointer, load_pytree, save_pytree
+from unionml_tpu.models import MLPClassifier, create_train_state, fit
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def test_save_load_pytree_roundtrip(tmp_path):
+    tree = {"w": jnp.arange(6.0).reshape(2, 3), "nested": {"b": jnp.zeros((3,))}}
+    path = tmp_path / "tree.ckpt"
+    save_pytree(tree, path, hyperparameters={"lr": 0.1})
+    restored = load_pytree(path, target=tree)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(tree["w"]))
+    np.testing.assert_array_equal(np.asarray(restored["nested"]["b"]), np.zeros(3))
+
+
+def test_checkpointer_step_save_restore(tmp_path):
+    ckpt = Checkpointer(tmp_path / "steps", save_interval_steps=1)
+    try:
+        assert ckpt.latest_step() is None
+        state = {"w": jnp.ones((4,)), "step": jnp.asarray(0)}
+        for step in range(3):
+            ckpt.save(step, {"w": state["w"] * (step + 1), "step": jnp.asarray(step)})
+        ckpt.flush()
+        assert ckpt.latest_step() == 2
+        restored = ckpt.restore({"w": jnp.zeros((4,)), "step": jnp.asarray(0)})
+        np.testing.assert_array_equal(np.asarray(restored["w"]), 3 * np.ones(4))
+        assert int(restored["step"]) == 2
+        # explicit historical step
+        older = ckpt.restore({"w": jnp.zeros((4,)), "step": jnp.asarray(0)}, step=1)
+        np.testing.assert_array_equal(np.asarray(older["w"]), 2 * np.ones(4))
+    finally:
+        ckpt.close()
+
+
+def test_checkpointer_restore_missing_raises(tmp_path):
+    ckpt = Checkpointer(tmp_path / "empty")
+    try:
+        with pytest.raises(FileNotFoundError, match="No checkpoint"):
+            ckpt.restore({"w": jnp.zeros(2)})
+    finally:
+        ckpt.close()
+
+
+def test_checkpointer_sharded_restore_preserves_layout(tmp_path):
+    """Restore into a mesh-sharded target must come back with the target's sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from unionml_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    sharding = NamedSharding(mesh, P("data"))
+    value = jax.device_put(jnp.arange(16.0), sharding)
+
+    ckpt = Checkpointer(tmp_path / "sharded")
+    try:
+        ckpt.save(0, {"v": value})
+        ckpt.flush()
+        target = {"v": jax.device_put(jnp.zeros(16), sharding)}
+        restored = ckpt.restore(target)
+        np.testing.assert_array_equal(np.asarray(restored["v"]), np.arange(16.0))
+        assert restored["v"].sharding == sharding
+    finally:
+        ckpt.close()
+
+
+def test_fit_resumes_from_latest_step(tmp_path):
+    rng = np.random.default_rng(0)
+    data = {
+        "inputs": rng.normal(size=(64, 8)).astype(np.float32),
+        "labels": rng.integers(0, 2, size=64).astype(np.int32),
+    }
+    mlp = MLPClassifier(hidden_sizes=(8,), num_classes=2)
+    params = mlp.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    ckpt_dir = str(tmp_path / "fitckpt")
+
+    state = create_train_state(mlp, params, learning_rate=1e-2)
+    first = fit(state, data, batch_size=16, num_epochs=2,
+                checkpoint_dir=ckpt_dir, checkpoint_every=2, log_every=1000)
+    probe = Checkpointer(ckpt_dir)
+    try:
+        latest = probe.latest_step()
+    finally:
+        probe.close()
+    assert latest is not None and latest > 0
+
+    # a fresh state + the same dir resumes from the checkpoint, not step 0
+    state2 = create_train_state(mlp, params, learning_rate=1e-2)
+    resumed = fit(state2, data, batch_size=16, num_epochs=2,
+                  checkpoint_dir=ckpt_dir, checkpoint_every=2, log_every=1000)
+    assert int(resumed.state.step) >= latest
+
+
+def test_sigterm_flushes_pending_saves(tmp_path):
+    """Preemption contract, end to end in a subprocess: SIGTERM triggers the handler,
+    the pending async save lands, and the process exits with the SIGTERM code."""
+    script = textwrap.dedent(
+        f"""
+        import os, signal, sys
+        sys.path.insert(0, {str(REPO_ROOT)!r})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax.numpy as jnp
+        from unionml_tpu.checkpoint import Checkpointer, install_preemption_handler
+
+        ckpt = Checkpointer({str(tmp_path / "preempt")!r})
+        install_preemption_handler(ckpt)
+        ckpt.save(7, {{"w": jnp.ones((128, 128))}})  # async save in flight
+        print("READY", flush=True)
+        os.kill(os.getpid(), signal.SIGTERM)
+        print("UNREACHABLE", flush=True)
+        """
+    )
+    result = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu"},
+    )
+    assert "READY" in result.stdout
+    assert "UNREACHABLE" not in result.stdout  # the handler exited the process
+    assert result.returncode != 0  # SIGTERM exit, not a clean 0
+
+    ckpt = Checkpointer(tmp_path / "preempt")
+    try:
+        assert ckpt.latest_step() == 7  # the in-flight save landed before exit
+        restored = ckpt.restore({"w": jnp.zeros((128, 128))})
+        np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones((128, 128)))
+    finally:
+        ckpt.close()

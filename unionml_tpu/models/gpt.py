@@ -274,9 +274,12 @@ def param_shardings(params: Any, mesh_axis_names: Tuple[str, ...] = ("data", "te
     """
     from jax.sharding import PartitionSpec as P
 
-    tensor = "tensor" if "tensor" in mesh_axis_names else None
-    fsdp = "fsdp" if "fsdp" in mesh_axis_names else None
-    expert = "expert" if "expert" in mesh_axis_names else None
+    from unionml_tpu.parallel.ep import EXPERT_AXIS
+    from unionml_tpu.parallel.mesh import FSDP_AXIS, TENSOR_AXIS
+
+    tensor = TENSOR_AXIS if TENSOR_AXIS in mesh_axis_names else None
+    fsdp = FSDP_AXIS if FSDP_AXIS in mesh_axis_names else None
+    expert = EXPERT_AXIS if EXPERT_AXIS in mesh_axis_names else None
 
     def spec_for(path: Tuple[str, ...], leaf) -> P:
         path_str = "/".join(str(p) for p in path)
