@@ -17,10 +17,11 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 
 WORKDIR /root
 
-# jax[tpu] pulls libtpu via the Google releases index; CPU fallback works everywhere
+# jax[tpu] pulls libtpu via the Google releases index; CPU fallback works everywhere.
+# The [gcs] extra provides the fsspec/GCS artifact store pod fleets share state through.
 RUN pip install --no-cache-dir "jax[tpu]" \
       -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    && pip install --no-cache-dir unionml-tpu scikit-learn
+    && pip install --no-cache-dir "unionml-tpu[gcs]" scikit-learn
 
 COPY ${APP_DIR} /root/app
 WORKDIR /root/app

@@ -29,6 +29,7 @@ setup(
     extras_require={
         "sklearn": ["scikit-learn"],
         "fastapi": ["fastapi", "uvicorn"],
+        "gcs": ["fsspec", "gcsfs"],
         "torch": ["torch"],
     },
     entry_points={"console_scripts": ["unionml-tpu = unionml_tpu.cli:main"]},

@@ -15,7 +15,7 @@ from unionml_tpu.templates import list_templates, render_template
 def test_list_templates():
     assert set(list_templates()) >= {
         "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
-        "serverless", "torch-digits", "keras-mnist", "gpt-textgen",
+        "serverless", "torch-digits", "keras-mnist", "gpt-textgen", "moe-textgen",
     }
 
 
@@ -23,7 +23,7 @@ def test_list_templates():
     "template",
     [
         "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
-        "serverless", "torch-digits", "keras-mnist", "gpt-textgen",
+        "serverless", "torch-digits", "keras-mnist", "gpt-textgen", "moe-textgen",
     ],
 )
 def test_render_template_compiles(template, tmp_path):
@@ -138,3 +138,16 @@ def test_cli_remote_roundtrip(tmp_path, monkeypatch):
     )
     assert result.exit_code == 0, result.output
     assert out_file.exists()
+
+
+def test_moe_template_trains_and_generates(tmp_path):
+    """The sparse-GPT template runs end to end: train w/ aux losses, generate."""
+    import runpy
+
+    target = render_template("moe-textgen", "moe_app", tmp_path)
+    namespace = runpy.run_path(str(target / "app.py"), run_name="not_main")
+    model = namespace["model"]
+    state, metrics = model.train(trainer_kwargs={"num_steps": 30, "batch_size": 16})
+    assert metrics["train"] > 0
+    out = model.predict(features={"prompt": ["the quick "], "max_new_tokens": 8})
+    assert out.shape[1] == len("the quick ") + 8

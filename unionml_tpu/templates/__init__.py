@@ -22,6 +22,7 @@ _DESCRIPTIONS = {
     "torch-digits": "pytorch MLP digits classifier (opaque-trainer path)",
     "keras-mnist": "Keras MNIST CNN (the reference tutorial recipe, opaque path)",
     "gpt-textgen": "character-level GPT text generation with KV-cache decoding",
+    "moe-textgen": "sparse (mixture-of-experts) GPT text generation with router aux losses",
 }
 
 
