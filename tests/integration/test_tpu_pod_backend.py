@@ -211,3 +211,27 @@ def test_parse_pod_target_and_model_remote_string(tmp_path, monkeypatch):
     model.remote_deploy(app_version="str-v1")
     artifact = model.remote_train(app_version="str-v1", n=40, wait=True)
     assert artifact.metrics["test"] > 0.6
+
+
+def test_pod_backend_retry_budget(tmp_path, monkeypatch):
+    """Job-level retries are inherited by the pod backend: a worker that fails on
+    its first attempts succeeds within the budget (parity with the LocalBackend
+    flaky-app test, but through the transport boundary)."""
+    monkeypatch.setenv("PYTHONPATH", str(REPO_ROOT))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("UNIONML_TEST_FLAKY_DIR", str(tmp_path / "flaky"))
+    monkeypatch.chdir(REPO_ROOT)
+
+    from tests.integration.flaky_app import model
+    from unionml_tpu.backend.tpu_pod import LocalShellTransport, TPUPodBackend
+
+    backend = TPUPodBackend(
+        store_url=f"file://{tmp_path}/store",
+        transport=LocalShellTransport(host_count=1, scratch=str(tmp_path / "scratch")),
+        retries=2,
+    )
+    model.remote(backend)
+    model._artifact = None
+    model.remote_deploy(app_version="flaky-pod-v1")
+    artifact = model.remote_train(app_version="flaky-pod-v1", wait=True)
+    assert artifact is not None

@@ -222,3 +222,33 @@ def test_gpt_param_shardings_cover_tree_and_train_sharded():
     # gradients inherit the parameter layouts
     qkv_grad = grads["layer_0"]["qkv"]["kernel"]
     assert "tensor" in str(qkv_grad.sharding.spec)
+
+
+def test_gpt_hf_weight_parity():
+    """Imported HF GPT-2 weights must reproduce transformers' logits."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import numpy as np
+
+    from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel, import_hf_weights
+
+    hf_config = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.GPT2LMHeadModel(hf_config).eval()
+
+    config = GPTConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        max_position_embeddings=64, dropout=0.0, dtype=jnp.float32, attention_impl="xla",
+    )
+    variables = import_hf_weights(hf_model.state_dict(), config)
+
+    ids = np.random.default_rng(0).integers(0, 128, size=(2, 16))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(GPTLMHeadModel(config).apply(
+        jax.tree_util.tree_map(jnp.asarray, variables), jnp.asarray(ids)
+    ))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4)

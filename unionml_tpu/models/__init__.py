@@ -19,6 +19,7 @@ from unionml_tpu.models.bert import (
 from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel
 from unionml_tpu.models.gpt import generate as gpt_generate
 from unionml_tpu.models.gpt import init_cache as init_gpt_cache
+from unionml_tpu.models.gpt import import_hf_weights as import_hf_gpt_weights
 from unionml_tpu.models.gpt import init_params as init_gpt_params
 from unionml_tpu.models.gpt import lm_loss as gpt_lm_loss
 from unionml_tpu.models.mlp import CNNClassifier, MLPClassifier
@@ -45,6 +46,7 @@ __all__ = [
     "CNNClassifier",
     "FitResult",
     "MoEMlp",
+    "import_hf_gpt_weights",
     "collect_aux_losses",
     "load_balancing_loss",
     "router_z_loss",

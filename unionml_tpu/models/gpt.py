@@ -303,3 +303,44 @@ def param_shardings(params: Any, mesh_axis_names: Tuple[str, ...] = ("data", "te
     from unionml_tpu.models._sharding import shard_by_rules
 
     return shard_by_rules(params, spec_for)
+
+
+def import_hf_weights(hf_state_dict: Dict[str, Any], config: GPTConfig) -> Dict[str, Any]:
+    """Map a HuggingFace GPT-2 state dict (torch tensors or numpy) onto this module.
+
+    Accepts ``GPT2Model`` or ``GPT2LMHeadModel`` state dicts. HF GPT-2 uses Conv1D
+    projections whose weights are already (in, out) — no transpose, unlike torch
+    Linear — and ties the LM head to ``wte``, matching this module's tied head.
+    Mirrors :func:`unionml_tpu.models.bert.import_hf_weights` for the encoder family.
+    """
+
+    def t(name: str) -> np.ndarray:
+        value = hf_state_dict[name]
+        if hasattr(value, "detach"):
+            value = value.detach().cpu().numpy()
+        return np.asarray(value)
+
+    def conv1d(prefix: str) -> Dict[str, np.ndarray]:
+        # HF Conv1D stores weight as (in_features, out_features): flax kernel layout
+        return {"kernel": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    def norm(prefix: str) -> Dict[str, np.ndarray]:
+        return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    prefix = "transformer." if any(key.startswith("transformer.") for key in hf_state_dict) else ""
+    params: Dict[str, Any] = {
+        "wte": {"embedding": t(f"{prefix}wte.weight")},
+        "wpe": {"embedding": t(f"{prefix}wpe.weight")},
+        "final_norm": norm(f"{prefix}ln_f"),
+    }
+    for i in range(config.num_layers):
+        hf_layer = f"{prefix}h.{i}"
+        params[f"layer_{i}"] = {
+            "attn_norm": norm(f"{hf_layer}.ln_1"),
+            "qkv": conv1d(f"{hf_layer}.attn.c_attn"),
+            "attn_out": conv1d(f"{hf_layer}.attn.c_proj"),
+            "mlp_norm": norm(f"{hf_layer}.ln_2"),
+            "mlp_up": conv1d(f"{hf_layer}.mlp.c_fc"),
+            "mlp_down": conv1d(f"{hf_layer}.mlp.c_proj"),
+        }
+    return {"params": params}
