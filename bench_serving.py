@@ -1,12 +1,14 @@
 """Serving-latency microbench: resident-predictor p50/p99 (BASELINE.md metric 2).
 
-Measures the in-process request path — feature pipeline, pad-to-bucket, resident
-compiled executable, device->host — for single-row requests against two apps:
+Three measurements, single-row requests each:
 
-1. **digits-style MLP** over flat feature columns (the reference quickstart shape,
+1. **digits-style MLP, in-process** — feature pipeline, pad-to-bucket, resident
+   compiled executable, device->host (the reference quickstart shape,
    ``unionml/fastapi.py:50-64`` hot path);
-2. **BERT classifier** over tokenized dict features, exercising sequence-length
-   bucketing (the multi-input warmup path VERDICT round-1 flagged).
+2. **BERT classifier, in-process** — tokenized dict features exercising
+   sequence-length bucketing (the multi-input warmup path VERDICT round-1 flagged);
+3. **digits-style MLP over HTTP** — the same model behind the real aiohttp server,
+   measuring the full served path end to end.
 
 Cold-start (compilation) is excluded: each app takes one untimed warm request first.
 Writes ``SERVING_BENCH.json`` (committed artifact) and prints one JSON line per model.
@@ -39,17 +41,17 @@ def _measure(fn, iters=200):
     }
 
 
-def bench_mlp():
+def _build_mlp_model(name: str):
+    """The shared 64-feature MLP app both MLP benches measure (keep them comparable)."""
     import jax
     import jax.numpy as jnp
     import pandas as pd
 
     from unionml_tpu import Dataset, Model
-    from unionml_tpu.serving import ResidentPredictor
 
     n_features = 64
     feature_names = [f"f{i}" for i in range(n_features)]
-    dataset = Dataset(name="bench_ds", features=feature_names, targets=["y"], device_format="jax")
+    dataset = Dataset(name=f"{name}_ds", features=feature_names, targets=["y"], device_format="jax")
 
     def init(scale: float = 1.0) -> dict:
         rng = np.random.default_rng(0)
@@ -58,7 +60,7 @@ def bench_mlp():
             "w2": jnp.asarray(rng.normal(size=(128, 10)) * 0.1, dtype=jnp.float32),
         }
 
-    model = Model(name="bench_model", init=init, dataset=dataset)
+    model = Model(name=name, init=init, dataset=dataset)
 
     @dataset.reader
     def reader(n: int = 256) -> pd.DataFrame:
@@ -79,11 +81,18 @@ def bench_mlp():
     def evaluator(params: dict, X: jax.Array, y: jax.Array) -> float:
         return 0.0
 
+    return model, feature_names
+
+
+def bench_mlp():
+    from unionml_tpu.serving import ResidentPredictor
+
+    model, feature_names = _build_mlp_model("bench_model")
     model.train()
     resident = ResidentPredictor(model, warmup=True)
     resident.setup()
 
-    request = [dict(zip(feature_names, np.random.default_rng(1).normal(size=n_features)))]
+    request = [dict(zip(feature_names, np.random.default_rng(1).normal(size=64)))]
     return _measure(lambda: resident.predict(features=request))
 
 
@@ -179,6 +188,79 @@ def bench_bert(base: bool = False, seq_bucket: int = 128):
     return _measure(lambda: resident.predict(features=example), iters=100)
 
 
+def bench_http(iters: int = 200):
+    """End-to-end HTTP p50/p99 against the real aiohttp server: boots the server in
+    this process on a free port, drives single-row POST /predict requests, and tears
+    the runner/loop/thread down afterwards."""
+    import asyncio
+    import json as _json
+    import threading
+    import urllib.request
+
+    from aiohttp import web
+
+    from unionml_tpu.model import ModelArtifact
+    from unionml_tpu.serving import build_aiohttp_app
+    from unionml_tpu.utils import pick_free_port
+
+    model, feature_names = _build_mlp_model("http_bench_model")
+    model.artifact = ModelArtifact(model._init_model_object({}), None, None)
+
+    port = pick_free_port()
+    app = build_aiohttp_app(model)
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    box = {}
+
+    def serve():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            box["runner"] = runner
+
+        try:
+            loop.run_until_complete(boot())
+        except Exception as exc:  # propagate bind/setup failures to the caller
+            box["error"] = exc
+            started.set()
+            return
+        started.set()
+        loop.run_forever()
+        # cooperative teardown once the caller stops the loop
+        loop.run_until_complete(box["runner"].cleanup())
+        loop.close()
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    if not started.wait(30):
+        raise RuntimeError("HTTP bench server did not start within 30s")
+    if "error" in box:
+        raise RuntimeError("HTTP bench server failed to start") from box["error"]
+
+    payload = _json.dumps(
+        {"features": [dict(zip(feature_names, np.random.default_rng(1).normal(size=64)))]}
+    ).encode()
+
+    def request():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as response:
+            response.read()
+
+    try:
+        return _measure(request, iters=iters)
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=30)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--bert-base", action="store_true", help="bench full BERT-base (TPU)")
@@ -205,6 +287,11 @@ def main():
     results["models"][name] = bert
     print(json.dumps({"metric": "resident_predict_p50_ms", "value": bert["p50_ms"], "unit": "ms",
                       "model": name, "p99_ms": bert["p99_ms"], "backend": backend}))
+
+    http = bench_http()
+    results["models"]["digits_mlp_64f_http"] = http
+    print(json.dumps({"metric": "http_predict_p50_ms", "value": http["p50_ms"], "unit": "ms",
+                      "model": "digits_mlp_64f_http", "p99_ms": http["p99_ms"], "backend": backend}))
 
     with open(args.out, "w") as fh:
         json.dump(results, fh, indent=2)
