@@ -61,12 +61,21 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, hidden, cache: Optional[Dict[str, jax.Array]], position, deterministic: bool):
+    def __call__(
+        self,
+        hidden,
+        cache: Optional[Dict[str, jax.Array]],
+        position,
+        deterministic: bool,
+        pad_offsets: Optional[jax.Array] = None,
+    ):
         """Full-sequence (cache=None) or single-token incremental (cache given) step.
 
         Incremental contract: ``hidden`` is (batch, 1, d); ``cache`` holds
         ``{"k","v"}`` of shape (batch, heads, max_len, head_dim) plus the write
-        ``position`` (scalar). Returns (hidden, new_cache).
+        ``position`` (scalar). ``pad_offsets`` is a (batch,) count of LEFT-pad tokens
+        per row (ragged-prompt batching): key positions below a row's offset are
+        masked for that row. Returns (hidden, new_cache).
         """
         cfg = self.config
         batch, seq, _ = hidden.shape
@@ -76,25 +85,38 @@ class DecoderBlock(nn.Module):
         split = lambda x: x.reshape(batch, seq, cfg.num_heads, cfg.head_dim).transpose(0, 2, 1, 3)
         q, k, v = split(q), split(k), split(v)
 
+        def pad_mask(k_positions):
+            # (batch, 1, 1, Lk): keys in a row's left-pad region contribute nothing
+            return (k_positions[None, :] >= pad_offsets[:, None])[:, None, None, :]
+
         if cache is None:
-            context = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            if pad_offsets is None:
+                context = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            else:
+                # causal=True supplies the triangular part; only the pad mask is ours
+                context = xla_attention(q, k, v, causal=True, mask=pad_mask(jnp.arange(seq)))
             new_cache = None
         else:
             # write the new K/V block at `position`; works for single-token decode
             # (seq=1) AND chunked prefill (seq=prompt_len, position=0)
             k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, 0, position, 0))
             v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, 0, position, 0))
-            if seq > 1 and isinstance(position, int) and position == 0:
+            if seq > 1 and isinstance(position, int) and position == 0 and pad_offsets is None:
                 # start-of-sequence prefill: no earlier keys exist, so plain causal
                 # attention over the chunk (the flash kernel on TPU) is exact — no
                 # dense mask, no scoring against empty cache slots
                 context = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            elif seq > 1 and isinstance(position, int) and position == 0:
+                # ragged prefill: attend over the chunk, causal + left-pad masked
+                context = xla_attention(q, k, v, causal=True, mask=pad_mask(jnp.arange(seq)))
             else:
                 # decode step / mid-sequence chunk: attend over the cache with a
-                # global-position causal mask
+                # global-position causal mask (+ per-row left-pad mask when ragged)
                 q_pos = position + jnp.arange(seq)
                 k_pos = jnp.arange(k_cache.shape[2])
                 mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+                if pad_offsets is not None:
+                    mask = mask & pad_mask(k_pos)
                 context = xla_attention(q, k_cache, v_cache, mask=mask)
             new_cache = {"k": k_cache, "v": v_cache}
 
@@ -137,14 +159,29 @@ class GPTLMHeadModel(nn.Module):
         cache: Optional[Dict[str, Any]] = None,
         position: Optional[jax.Array] = None,
         deterministic: bool = True,
+        pad_offsets: Optional[jax.Array] = None,
     ):
+        """``pad_offsets`` (batch,) enables ragged-prompt batching: rows are LEFT-
+        padded, each row's position embeddings start at its first real token, and
+        attention never sees a row's pad region. Requires ``deterministic=True`` on
+        sparse configs: capacity-bounded expert dispatch has no row isolation (pad
+        tokens would compete for expert capacity slots against real tokens)."""
         cfg = self.config
+        if pad_offsets is not None and cfg.moe_every > 0 and not deterministic:
+            raise ValueError(
+                "pad_offsets with a MoE config requires deterministic=True: "
+                "capacity-bounded expert dispatch lets pad tokens evict real tokens."
+            )
         batch, seq = input_ids.shape
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="wte")
         if cache is None:
             positions = jnp.arange(seq)[None, :]
         else:
             positions = (position + jnp.arange(seq))[None, :].astype(jnp.int32)
+        if pad_offsets is not None:
+            # each row's first REAL token gets position 0 (pad slots clamp to 0 —
+            # they are masked out of attention, the embedding just needs to be valid)
+            positions = jnp.maximum(positions - pad_offsets[:, None].astype(jnp.int32), 0)
         hidden = embed(input_ids) + nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype, name="wpe"
         )(positions)
@@ -155,7 +192,7 @@ class GPTLMHeadModel(nn.Module):
             layer_cache = None if cache is None else cache[f"layer_{i}"]
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
             hidden, layer_cache = DecoderBlock(cfg, use_moe=use_moe, name=f"layer_{i}")(
-                hidden, layer_cache, position, deterministic
+                hidden, layer_cache, position, deterministic, pad_offsets
             )
             if layer_cache is not None:
                 new_cache[f"layer_{i}"] = layer_cache
@@ -196,11 +233,15 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    prompt_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Autoregressive decoding with a KV cache; one compiled scan, O(1) per token.
 
     ``temperature=0`` is greedy; otherwise samples with the given temperature.
-    Returns (batch, prompt_len + max_new_tokens) token ids.
+    ``prompt_mask`` (batch, prompt_len; 1 = real token) batches RAGGED prompts:
+    rows must be LEFT-padded, so shorter prompts carry leading pad tokens that
+    attention ignores and position embeddings skip — each row decodes exactly as it
+    would alone. Returns (batch, prompt_len + max_new_tokens) token ids.
     """
     config = model.config
     batch, prompt_len = prompt_ids.shape
@@ -218,10 +259,17 @@ def generate(
         )
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+    pad_offsets = None
+    if prompt_mask is not None:
+        # left padding means each row's pad count is its number of leading zeros
+        pad_offsets = (prompt_len - jnp.sum(prompt_mask.astype(jnp.int32), axis=1)).astype(jnp.int32)
+
     cache = init_cache(config, batch, max_len)
 
     # chunked prefill: one forward over the whole prompt fills every layer's cache
-    logits, cache = model.apply(variables, prompt_ids, cache=cache, position=0)
+    logits, cache = model.apply(
+        variables, prompt_ids, cache=cache, position=0, pad_offsets=pad_offsets
+    )
     last_logits = logits[:, -1, :]
 
     def sample(logits, key):
@@ -233,7 +281,9 @@ def generate(
         cache, logits, key = carry
         key, subkey = jax.random.split(key)
         token = sample(logits, subkey)
-        new_logits, cache = model.apply(variables, token[:, None], cache=cache, position=prompt_len + t)
+        new_logits, cache = model.apply(
+            variables, token[:, None], cache=cache, position=prompt_len + t, pad_offsets=pad_offsets
+        )
         return (cache, new_logits[:, -1, :], key), token
 
     (_, _, _), tokens = jax.lax.scan(

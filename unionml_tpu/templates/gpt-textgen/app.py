@@ -97,24 +97,25 @@ def predictor(state: TrainState, features: Dict[str, np.ndarray]) -> np.ndarray:
     keep = config.max_position_embeddings - max_new
     prompts = [p[-keep:] for p in prompts]
 
-    # uniform lengths batch through one generate call; ragged prompts run singly —
-    # left-padding without an attention mask would condition short prompts on padding
-    def run(batch_ids: np.ndarray) -> np.ndarray:
-        out = generate(
-            gpt,
-            {"params": state.params},
-            jnp.asarray(batch_ids, dtype=jnp.int32),
-            max_new_tokens=max_new,
-            max_len=batch_ids.shape[1] + max_new,
-        )
-        return np.asarray(out)
-
-    lengths = {len(p) for p in prompts}
-    if len(lengths) == 1:
-        return run(np.stack(prompts))
-    rows = [run(p[None, :])[0] for p in prompts]
-    width = max(len(r) for r in rows)
-    return np.stack([np.pad(r, (width - len(r), 0)) for r in rows])
+    # ragged prompts batch through ONE generate call: rows left-pad to the longest
+    # prompt and prompt_mask keeps attention/positions exact per row. Uniform-length
+    # batches skip the mask so prefill keeps the maskless flash-attention fast path.
+    width = max(len(p) for p in prompts)
+    ragged = any(len(p) != width for p in prompts)
+    batch_ids = np.zeros((len(prompts), width), dtype=np.int32)
+    mask = np.zeros((len(prompts), width), dtype=np.int32)
+    for row, p in enumerate(prompts):
+        batch_ids[row, width - len(p) :] = p
+        mask[row, width - len(p) :] = 1
+    out = generate(
+        gpt,
+        {"params": state.params},
+        jnp.asarray(batch_ids),
+        max_new_tokens=max_new,
+        max_len=width + max_new,
+        prompt_mask=jnp.asarray(mask) if ragged else None,
+    )
+    return np.asarray(out)
 
 
 @model.evaluator
