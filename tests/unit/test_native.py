@@ -143,3 +143,35 @@ def test_prefetch_noop_conversion_accepted():
     for key, value in first.items():
         assert value.dtype == data[key].dtype
     loader.close()
+
+
+def test_fit_prefetch_convert_handles_raw_pandas_dtypes():
+    """fit(prefetch_convert=...) converts f64/i64 data in the native workers."""
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import MLPClassifier, create_train_state, fit
+
+    rng = np.random.default_rng(0)
+    data = {
+        "inputs": rng.normal(size=(128, 8)),                        # float64 (pandas-style)
+        "labels": rng.integers(0, 2, size=128).astype(np.int64),    # int64
+    }
+    model = MLPClassifier(hidden_sizes=(8,), num_classes=2)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    state = create_train_state(model, params, learning_rate=1e-2)
+    result = fit(
+        state, data, batch_size=32, num_epochs=2, log_every=10000, prefetch=True,
+        prefetch_convert={"inputs": "float32", "labels": "int32"},
+    )
+    assert result.steps >= 8
+
+    # the convert dict demonstrably reaches the loader: its validation fires on a
+    # bad key / on use without prefetch (so dropping the plumbing fails this test)
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown arrays"):
+        fit(state, data, batch_size=32, num_epochs=1, prefetch=True,
+            prefetch_convert={"typo": "float32"})
+    with pytest.raises(ValueError, match="requires prefetch=True"):
+        fit(state, data, batch_size=32, num_epochs=1, prefetch_convert={"inputs": "float32"})

@@ -180,21 +180,31 @@ def fit(
     log_every: int = 50,
     seed: int = 0,
     prefetch: bool = False,
+    prefetch_convert: Optional[Dict[str, str]] = None,
 ) -> FitResult:
     """Run the compiled train loop; resumes from ``checkpoint_dir`` when present.
 
     ``prefetch=True`` gathers batches with the native threaded prefetcher
     (:class:`unionml_tpu.native.PrefetchLoader`), overlapping host-side batch assembly
     with device compute; falls back to Python batching when the native build is
-    unavailable.
+    unavailable. ``prefetch_convert`` (e.g. ``{"inputs": "float32", "labels":
+    "int32"}`` for raw pandas f64/i64 data, or ``{"inputs": "bfloat16"}`` for
+    float32 sources) runs the per-array dtype conversion inside the native worker
+    threads during the gather, so host data reaches the device in its compute
+    dtype without Python ever paying element-wise conversion. Requires
+    ``prefetch=True`` — silently skipping a requested conversion would be a
+    correctness trap.
     """
     step_fn = make_classifier_train_step(mesh=mesh, param_spec=param_spec, input_signature=input_signature)
+
+    if prefetch_convert and not prefetch:
+        raise ValueError("prefetch_convert requires prefetch=True (conversion runs in the native gather workers)")
 
     prefetch_loader = None
     if prefetch:
         from unionml_tpu.native import PrefetchLoader
 
-        prefetch_loader = PrefetchLoader(data, batch_size)
+        prefetch_loader = PrefetchLoader(data, batch_size, convert=prefetch_convert)
 
     def batch_iterator(epoch_rng):
         if prefetch_loader is not None:
