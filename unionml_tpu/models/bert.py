@@ -88,13 +88,11 @@ class BertSelfAttention(nn.Module):
         if cfg.attention_impl in ("ring", "ulysses"):
             # sequence-parallel long-context path: activations shard over the mesh's
             # "sequence" axis; padding arrives as per-batch kv_lens (right padding)
-            if cfg.sp_mesh is None:
-                raise ValueError(f"attention_impl={cfg.attention_impl!r} requires BertConfig.sp_mesh")
-            from unionml_tpu.parallel.ring import ring_attention
-            from unionml_tpu.parallel.ulysses import ulysses_attention
+            from unionml_tpu.parallel import sp_attention
 
-            sp_fn = ring_attention if cfg.attention_impl == "ring" else ulysses_attention
-            context = sp_fn(split(q), split(k), split(v), cfg.sp_mesh, kv_lens=kv_lens)
+            context = sp_attention(
+                split(q), split(k), split(v), cfg.sp_mesh, cfg.attention_impl, kv_lens=kv_lens
+            )
         else:
             context = attention(
                 split(q), split(k), split(v), mask=dense_mask, kv_lens=kv_lens, impl=cfg.attention_impl

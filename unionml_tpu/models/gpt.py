@@ -33,7 +33,12 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     dropout: float = 0.1
     dtype: Any = jnp.bfloat16
+    #: "auto" | "xla" | "pallas" | "ring" | "ulysses" — the last two are the
+    #: sequence-parallel long-context TRAINING paths and require ``sp_mesh``
+    #: (generation/KV-cache paths fall back to per-token attention)
     attention_impl: str = "auto"
+    #: mesh carrying a "sequence" axis for ring/ulysses attention
+    sp_mesh: Any = None
     #: sparse (mixture-of-experts) variant: every Nth block swaps its dense MLP for
     #: a routed :class:`unionml_tpu.models.moe.MoEMlp` (0 = fully dense). Router
     #: aux losses sow under "intermediates" — fold them into the training loss with
@@ -90,7 +95,22 @@ class DecoderBlock(nn.Module):
             return (k_positions[None, :] >= pad_offsets[:, None])[:, None, None, :]
 
         if cache is None:
-            if pad_offsets is None:
+            if cfg.attention_impl in ("ring", "ulysses"):
+                # sequence-parallel long-context training: activations shard over
+                # the mesh's "sequence" axis; causal masking is handled inside
+                if pad_offsets is not None:
+                    # silently dropping to dense attention would defeat the O(seq/N)
+                    # memory the sp layout exists for (and GPT's LEFT padding does
+                    # not map onto the kernels' right-padding kv_lens contract)
+                    raise ValueError(
+                        "ring/ulysses attention does not support pad_offsets (left-padded "
+                        "ragged batches); train sequence-parallel configs on uniform-length "
+                        "batches or use a dense attention_impl."
+                    )
+                from unionml_tpu.parallel import sp_attention
+
+                context = sp_attention(q, k, v, cfg.sp_mesh, cfg.attention_impl, causal=True)
+            elif pad_offsets is None:
                 context = attention(q, k, v, causal=True, impl=cfg.attention_impl)
             else:
                 # causal=True supplies the triangular part; only the pad mask is ours
@@ -104,8 +124,11 @@ class DecoderBlock(nn.Module):
             if seq > 1 and isinstance(position, int) and position == 0 and pad_offsets is None:
                 # start-of-sequence prefill: no earlier keys exist, so plain causal
                 # attention over the chunk (the flash kernel on TPU) is exact — no
-                # dense mask, no scoring against empty cache slots
-                context = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+                # dense mask, no scoring against empty cache slots. Sequence-parallel
+                # impls are a TRAINING layout; cache paths fall back to standard
+                # (non-sequence-parallel) attention.
+                impl = "auto" if cfg.attention_impl in ("ring", "ulysses") else cfg.attention_impl
+                context = attention(q, k, v, causal=True, impl=impl)
             elif seq > 1 and isinstance(position, int) and position == 0:
                 # ragged prefill: attend over the chunk, causal + left-pad masked
                 context = xla_attention(q, k, v, causal=True, mask=pad_mask(jnp.arange(seq)))

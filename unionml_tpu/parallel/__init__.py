@@ -40,6 +40,7 @@ __all__ = [
     "moe_apply_capacity",
     "moe_apply_topk",
     "pipeline_apply",
+    "sp_attention",
     "superstage",
     "stage_sharding",
     "make_hybrid_mesh",
@@ -51,3 +52,22 @@ __all__ = [
     "shard_batch",
     "ulysses_attention",
 ]
+
+
+def sp_attention(q, k, v, mesh, impl: str, *, causal: bool = False, kv_lens=None):
+    """Dispatch to a sequence-parallel attention impl ("ring" | "ulysses").
+
+    The single place both model families route their long-context path through —
+    one mesh check, one impl table (new strategies land here once).
+    """
+    if mesh is None:
+        raise ValueError(f"attention_impl={impl!r} requires a sequence-parallel mesh (sp_mesh)")
+    from unionml_tpu.parallel.ring import ring_attention
+    from unionml_tpu.parallel.ulysses import ulysses_attention
+
+    table = {"ring": ring_attention, "ulysses": ulysses_attention}
+    try:
+        fn = table[impl]
+    except KeyError:
+        raise ValueError(f"Unknown sequence-parallel impl {impl!r}; expected one of {sorted(table)}") from None
+    return fn(q, k, v, mesh, causal=causal, kv_lens=kv_lens)
