@@ -25,11 +25,12 @@ setup(
         "click",
         "aiohttp",
         "pyyaml",
+        "fsspec",
     ],
     extras_require={
         "sklearn": ["scikit-learn"],
         "fastapi": ["fastapi", "uvicorn"],
-        "gcs": ["fsspec", "gcsfs"],
+        "gcs": ["gcsfs"],
         "torch": ["torch"],
     },
     entry_points={"console_scripts": ["unionml-tpu = unionml_tpu.cli:main"]},

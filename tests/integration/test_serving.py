@@ -165,3 +165,15 @@ def test_concurrent_requests_coalesce(served_model):
     assert stats["resident"] is True
     assert stats["coalescing"]["requests"] >= 12
     assert stats["coalescing"]["batches"] <= stats["coalescing"]["requests"]
+
+
+def test_empty_inputs_does_not_shadow_features(served_model):
+    """Round-wide review regression: {"inputs": {}, "features": [...]} predicts on
+    the supplied features, not the reader defaults."""
+    port, _ = served_model
+    _wait_for_health(port)
+    predictions = _post_predict(
+        port,
+        {"inputs": {}, "features": [{"x1": 2.0, "x2": 2.0}, {"x1": -3.0, "x2": -3.0}]},
+    )
+    assert predictions == [1.0, 0.0]

@@ -296,3 +296,16 @@ def test_predict_zero_args_rejected_when_reader_needs_args():
     model.artifact = ModelArtifact({}, None, None)
     with pytest.raises(ValueError, match="features or \\*\\*reader_kwargs"):
         model.predict()
+
+
+def test_attribute_error_during_trace_falls_back_eagerly():
+    """Round-wide review regression: numpy-only methods on tracers (AttributeError)
+    must fall back per call signature, like other trace-time failures."""
+
+    def f(x):
+        return np.frombuffer(x.tobytes(), dtype=np.float32)  # tracers have no tobytes
+
+    tf = TracedFunction(f, jit="auto")
+    out = tf(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(out), [1.0, 2.0])
+    assert tf.uses_jit  # fallback was per-signature, not a permanent downgrade

@@ -208,3 +208,16 @@ def test_resident_flat_features_warmup_unchanged():
     resident.setup()
     out = resident.predict(features=[{"a": 1.0, "b": 2.0}])
     assert np.asarray(out).shape == (1,)
+
+
+def test_seq_buckets_never_pad_single_flat_integer_matrix():
+    """Round-wide review regression: a flat (batch, k) INTEGER feature matrix (ordinal
+    encodings) must keep its width even with seq_buckets configured — only dict
+    (multi-input) features get sequence-dim padding."""
+    model = _build_tokenized_model()
+    resident = ResidentPredictor(model, buckets=(4,), seq_buckets=(64,), warmup=False)
+    resident.setup()
+    flat_int = np.ones((2, 10), dtype=np.int32)  # single array, NOT a dict
+    padded, n, bucket = resident._pad_to_buckets(flat_int)
+    assert n == 2 and bucket == 4
+    assert padded.shape == (4, 10)  # width untouched

@@ -222,12 +222,21 @@ class TPUPodBackend(LocalBackend):
 
     def deploy_workflow(self, model: Any, workflow_name: str, app_version: str, patch: bool = False) -> None:
         super().deploy_workflow(model, workflow_name, app_version, patch=patch)
-        if not self._source_zip(app_version).exists():
-            self.package_source(model, app_version)
+        # ALWAYS repackage: re-deploying changed app code under the same version
+        # (the reference's patch/fast-registration flow) must ship the new source,
+        # never a stale zip
+        self.package_source(model, app_version)
 
     def execute(self, model: Any, workflow_name: str, inputs: Dict[str, Any], app_version: Optional[str] = None, schedule_name: Optional[str] = None) -> Execution:
-        # dev convenience parity with LocalBackend: undeployed runs package on the fly
-        version = app_version or (self.list_app_versions() or ["dev"])[0]
+        # dev convenience parity with LocalBackend: undeployed runs package on the
+        # fly — under the SAME version the execution's meta will record (the spec's
+        # version when deployed, the "dev" fallback otherwise), so _spawn_worker
+        # always finds the zip it looks up
+        try:
+            spec = self.fetch_workflow_spec(workflow_name, app_version)
+            version = spec.get("app_version") or "dev"
+        except BackendError:
+            version = app_version or "dev"
         if not self._source_zip(version).exists():
             self.package_source(model, version)
         return super().execute(model, workflow_name, inputs, app_version=app_version, schedule_name=schedule_name)

@@ -387,6 +387,12 @@ def import_hf_weights(hf_state_dict: Dict[str, Any], config: GPTConfig) -> Dict[
     Mirrors :func:`unionml_tpu.models.bert.import_hf_weights` for the encoder family.
     """
 
+    if config.moe_every > 0:
+        raise ValueError(
+            "import_hf_weights supports dense GPT-2 checkpoints only: a sparse config "
+            "(moe_every > 0) has expert parameters with no HF counterpart."
+        )
+
     def t(name: str) -> np.ndarray:
         value = hf_state_dict[name]
         if hasattr(value, "detach"):

@@ -155,7 +155,9 @@ def build_aiohttp_app(
 
         loop = asyncio.get_running_loop()
         try:
-            if inputs is not None:
+            # empty {} means reader-defaults ONLY when no features came along —
+            # a boilerplate empty inputs key must not shadow a real features payload
+            if inputs is not None and (inputs or features is None):
                 # off the event loop: compiled predictor calls block for milliseconds+
                 result = await loop.run_in_executor(
                     None,

@@ -65,7 +65,8 @@ def attach_fastapi(
     ):
         if inputs is None and features is None:
             raise HTTPException(status_code=500, detail="inputs or features must be supplied.")
-        if inputs is not None:  # empty {} means "run the reader with defaults" (matches app.py)
+        # empty {} means reader-defaults ONLY when no features came along (matches app.py)
+        if inputs is not None and (inputs or features is None):
             result = predictor.predict(**inputs) if predictor is not None else model.predict(**inputs)
         else:
             # model.predict runs the feature pipeline itself; don't pre-process here

@@ -145,7 +145,12 @@ class ResidentPredictor:
 
     def _pad_to_buckets(self, processed: Any):
         """Pad every array leaf's batch dim (and sequence dim, when configured) up the
-        bucket ladders. Returns (padded_pytree, original_batch, batch_bucket)."""
+        bucket ladders. Returns (padded_pytree, original_batch, batch_bucket).
+
+        Sequence-dim padding applies only to DICT (multi-input/tokenized) features: a
+        single flat feature MATRIX — even an integer one (ordinal/categorical
+        encodings) — has a fixed width that must never grow fabricated columns."""
+        is_multi_input = isinstance(processed, dict)
         flat = self._array_leaves(processed)
         if flat is None:
             raise ValueError("features are not a batch-dim array pytree")
@@ -164,7 +169,7 @@ class ResidentPredictor:
             # feature matrix whose width must never be padded (a dense (b, 10)
             # input would otherwise grow fabricated zero columns)
             is_seq_leaf = np.issubdtype(a.dtype, np.integer) or a.ndim >= 3
-            if self._seq_buckets is not None and a.ndim >= 2 and is_seq_leaf:
+            if self._seq_buckets is not None and a.ndim >= 2 and is_seq_leaf and is_multi_input:
                 seq = a.shape[1]
                 seq_bucket = _ladder_value(self._seq_buckets, seq)
                 if seq_bucket != seq:

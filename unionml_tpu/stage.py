@@ -40,9 +40,10 @@ _TRACEABLE_LEAVES = (jax.Array, np.ndarray, np.generic, float, int, bool, comple
 _STATIC_LEAVES = (str, bytes, type(None))
 _TRACE_FAILED_KEYS_MAX = 128
 # trace-time failures (data-dependent control flow, tracer leaks, concretization —
-# all TypeError subclasses in jax.errors) are eligible for eager fallback; runtime
-# errors from compiled executables (JaxRuntimeError etc.) propagate instead
-_TRACE_FAILURES = (TypeError, jax.errors.UnexpectedTracerError)
+# all TypeError subclasses in jax.errors — plus AttributeError from numpy-only
+# methods called on tracers) are eligible for eager fallback; runtime errors from
+# compiled executables (JaxRuntimeError etc.) propagate instead
+_TRACE_FAILURES = (TypeError, AttributeError, jax.errors.UnexpectedTracerError)
 
 
 def is_jax_compatible(tree: Any) -> bool:
