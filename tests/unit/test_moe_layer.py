@@ -99,3 +99,31 @@ def test_dropless_mode_never_drops_under_imbalance():
 
     capped = moe_apply_topk(lambda W, t: t @ W, eW, tokens, gates, k=2, capacity_factor=1.0)
     assert np.abs(np.asarray(capped) - np.asarray(ref)).max() > 1e-3  # drops happened
+
+
+def test_router_jitter_noise_training_only():
+    """Switch-style jitter perturbs routing only when an rng stream is supplied."""
+    layer = MoEMlp(num_experts=4, hidden_size=16, k=1, capacity_factor=4.0, router_noise=0.3)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(16, 8)), dtype=jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)
+
+    # no rng (eval): deterministic and identical to a noise-free layer
+    quiet = MoEMlp(num_experts=4, hidden_size=16, k=1, capacity_factor=4.0, router_noise=0.0)
+    np.testing.assert_array_equal(
+        np.asarray(layer.apply(params, x)), np.asarray(quiet.apply(params, x))
+    )
+
+    # with rng streams, different keys perturb the routing
+    out_a = layer.apply(params, x, rngs={"dropout": jax.random.PRNGKey(1)})
+    out_b = layer.apply(params, x, rngs={"dropout": jax.random.PRNGKey(2)})
+    assert float(jnp.max(jnp.abs(out_a - out_b))) > 0.0
+
+
+def test_router_noise_respects_deterministic_flag():
+    """deterministic=True silences jitter even when an rng stream is supplied."""
+    layer = MoEMlp(num_experts=4, hidden_size=16, k=1, capacity_factor=4.0, router_noise=0.3)
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(16, 8)), dtype=jnp.float32)
+    params = layer.init(jax.random.PRNGKey(0), x)
+    out_a = layer.apply(params, x, deterministic=True, rngs={"dropout": jax.random.PRNGKey(1)})
+    out_b = layer.apply(params, x, deterministic=True, rngs={"dropout": jax.random.PRNGKey(2)})
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))

@@ -47,6 +47,7 @@ class GPTConfig:
     num_experts: int = 8
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_router_noise: float = 0.0
 
     @classmethod
     def tiny(cls, **overrides) -> "GPTConfig":
@@ -159,9 +160,10 @@ class DecoderBlock(nn.Module):
                 hidden_size=4 * cfg.hidden_size,
                 k=cfg.moe_k,
                 capacity_factor=cfg.moe_capacity_factor,
+                router_noise=cfg.moe_router_noise,
                 dtype=cfg.dtype,
                 name="moe_mlp",
-            )(normed, dropless=deterministic)
+            )(normed, dropless=deterministic, deterministic=deterministic)
         else:
             up = nn.Dense(4 * cfg.hidden_size, dtype=cfg.dtype, name="mlp_up")(normed)
             up = nn.gelu(up, approximate=True)

@@ -65,17 +65,28 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 1.25
     mesh: Optional[Any] = None
     dtype: Any = jnp.float32
+    #: Switch-style multiplicative router jitter: router INPUTS scale by
+    #: U[1-noise, 1+noise] when a "dropout" rng stream is supplied (i.e. during
+    #: training); eval/generate calls carry no rng and stay deterministic.
+    router_noise: float = 0.0
 
     @nn.compact
-    def __call__(self, x: jax.Array, dropless: bool = False) -> jax.Array:
+    def __call__(self, x: jax.Array, dropless: bool = False, deterministic: bool = False) -> jax.Array:
         """``dropless=True`` disables the capacity drop (inference parity: a trained,
-        imbalanced router must not silently zero overflow tokens during decode)."""
+        imbalanced router must not silently zero overflow tokens during decode).
+        ``deterministic=True`` additionally disables router jitter even when an rng
+        stream is supplied — the same contract as ``nn.Dropout``."""
         d_model = x.shape[-1]
         tokens = x.reshape(-1, d_model)
 
-        router_logits = nn.Dense(self.num_experts, dtype=jnp.float32, name="router")(
-            tokens.astype(jnp.float32)
-        )
+        router_inputs = tokens.astype(jnp.float32)
+        if self.router_noise > 0.0 and not deterministic and self.has_rng("dropout"):
+            key = self.make_rng("dropout")
+            router_inputs = router_inputs * jax.random.uniform(
+                key, router_inputs.shape,
+                minval=1.0 - self.router_noise, maxval=1.0 + self.router_noise,
+            )
+        router_logits = nn.Dense(self.num_experts, dtype=jnp.float32, name="router")(router_inputs)
         gates = jax.nn.softmax(router_logits, axis=-1)
 
         self.sow("intermediates", "router_z_loss", router_z_loss(router_logits))
