@@ -235,3 +235,25 @@ def test_pod_backend_retry_budget(tmp_path, monkeypatch):
     model.remote_deploy(app_version="flaky-pod-v1")
     artifact = model.remote_train(app_version="flaky-pod-v1", wait=True)
     assert artifact is not None
+
+
+def test_pod_backend_schedules_fire_through_transport(pod_model):
+    """The in-process Scheduler drives the pod backend too: a fired cron execution
+    runs through the store + transport boundary, not in-process."""
+    import datetime
+
+    from unionml_tpu.backend import Scheduler
+
+    model, backend = pod_model
+    model.remote_deploy(app_version="sched-pod-v1", schedule=True)
+    assert any(r["name"] == "nightly-train" for r in backend.list_schedules())
+
+    scheduler = Scheduler(backend)
+    assert scheduler.tick(now=datetime.datetime(2026, 7, 1, 10, 0)) == []  # arm
+    fired = scheduler.tick(now=datetime.datetime(2026, 7, 2, 0, 1))
+    assert len(fired) == 1
+    execution = backend.wait(fired[0], timeout=180)
+    assert execution.status == "SUCCEEDED"
+    # proof it crossed the transport: fleet.json is written ONLY by the pod
+    # backend's _spawn_worker, never by an in-process run
+    assert (execution.directory / "fleet.json").exists()

@@ -83,3 +83,11 @@ def test_ssh_transport_poll_survives_transport_failure(monkeypatch):
         lambda host, cmd: sp.CompletedProcess(args=[], returncode=0, stdout="DEAD\n", stderr=""),
     )
     assert transport.poll(("tpu-host", 1234)) == 0
+
+
+def test_store_path_glob_and_ordering():
+    root = store_path("memory://glob-test")
+    for name in ["b.json", "a.json", "c.txt"]:
+        (root / name).write_text("{}")
+    names = [p.name for p in sorted(root.glob("*.json"))]
+    assert names == ["a.json", "b.json"]

@@ -66,6 +66,11 @@ class StorePath:
     def __eq__(self, other: Any) -> bool:
         return isinstance(other, StorePath) and other.url == self.url
 
+    def __lt__(self, other: Any):  # sorted() over listings
+        if not isinstance(other, StorePath):
+            return NotImplemented
+        return self.url < other.url
+
     def __hash__(self) -> int:
         return hash(self.url)
 
@@ -97,6 +102,15 @@ class StorePath:
             entry_path = entry_path.rstrip("/")
             if entry_path and entry_path != self._path:
                 yield StorePath(self._fs, entry_path, self._protocol)
+
+    def glob(self, pattern: str) -> Iterator["StorePath"]:
+        """Non-recursive glob over direct children (the backend's ``*.json`` case)."""
+        import fnmatch
+
+        for child in self.iterdir():
+            # fnmatchcase: platform-independent, matching pathlib.Path.glob semantics
+            if fnmatch.fnmatchcase(child.name, pattern):
+                yield child
 
     def open(self, mode: str = "r"):
         if "r" in mode and not self._fs.exists(self._path):
