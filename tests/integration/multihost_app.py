@@ -19,6 +19,20 @@ model = Model(name="mh_model", init=init, dataset=dataset)
 
 @dataset.reader
 def reader(n: int = 32) -> pd.DataFrame:
+    import os
+    import time
+
+    # fault-injection hook: keeps workers alive long enough for partial-death tests;
+    # the sentinel tells the test the worker genuinely REACHED the reader before
+    # sleeping (a Popen handle alone can't distinguish started from starting)
+    slow = float(os.environ.get("UNIONML_TEST_SLOW_READER_S", "0") or 0)
+    if slow:
+        sentinel = os.environ.get("UNIONML_TEST_SLOW_READER_SENTINEL")
+        if sentinel:
+            from pathlib import Path
+
+            Path(f"{sentinel}.{os.getpid()}").touch()
+        time.sleep(slow)
     rng = np.random.default_rng(0)
     return pd.DataFrame({"x": rng.normal(size=n), "y": rng.integers(0, 2, size=n)})
 

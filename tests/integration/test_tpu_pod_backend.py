@@ -257,3 +257,49 @@ def test_pod_backend_schedules_fire_through_transport(pod_model):
     # proof it crossed the transport: fleet.json is written ONLY by the pod
     # backend's _spawn_worker, never by an in-process run
     assert (execution.directory / "fleet.json").exists()
+
+
+def test_pod_fleet_partial_death_fails_deterministically(tmp_path, monkeypatch):
+    """Killing one host of a 2-host pod fleet mid-run tears down the survivor and
+    surfaces FAILED — the stuck-in-collectives survivor must not hang wait()."""
+    import time
+
+    monkeypatch.setenv("PYTHONPATH", str(REPO_ROOT))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(tmp_path))
+    monkeypatch.setenv("UNIONML_TEST_SLOW_READER_S", "30")  # keep workers alive to kill
+    sentinel = tmp_path / "reader-reached"
+    monkeypatch.setenv("UNIONML_TEST_SLOW_READER_SENTINEL", str(sentinel))
+    monkeypatch.chdir(REPO_ROOT)
+
+    from tests.integration.multihost_app import model
+    from unionml_tpu.backend.tpu_pod import LocalShellTransport, TPUPodBackend
+    from unionml_tpu.defaults import Resources
+    from unionml_tpu.exceptions import BackendError
+
+    backend = TPUPodBackend(
+        store_url=f"file://{tmp_path}/store",
+        transport=LocalShellTransport(host_count=2, scratch=str(tmp_path / "scratch")),
+    )
+    model.remote(backend, resources=Resources(accelerator="v5litepod-8", host_count=2))
+    model._artifact = None
+    model.remote_deploy(app_version="pd-v1")
+    execution = model.remote_train(app_version="pd-v1", wait=False)
+
+    # wait until BOTH workers have provably reached the (sleeping) reader — the
+    # sentinel files are touched from inside the worker processes — then kill one
+    # mid-run, while the survivor is still busy
+    fleet = backend._workers[execution.id]
+    deadline = time.monotonic() + 60
+    import glob as _glob
+
+    while time.monotonic() < deadline and len(_glob.glob(f"{sentinel}.*")) < 2:
+        time.sleep(0.2)
+    assert len(_glob.glob(f"{sentinel}.*")) == 2, "workers never reached the reader"
+    fleet[1].kill()
+
+    with pytest.raises(BackendError, match="failed"):
+        backend.wait(execution, timeout=120)
+    assert execution.status == "FAILED"
+    # the survivor was torn down, not left stuck in collectives
+    assert all(h.poll() is not None for h in fleet)
