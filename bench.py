@@ -22,8 +22,10 @@ logging.basicConfig(stream=sys.stderr)
 for noisy in ("jax", "unionml_tpu"):
     logging.getLogger(noisy).setLevel(logging.WARNING)
 
-#: round-1 v5e-1 measurement (examples/s); later rounds report vs_baseline against it.
-BASELINE_EXAMPLES_PER_S = None
+#: round-2 v5e-1 measurement (examples/s): BERT-base bf16, batch 32, seq 128, pallas
+#: flash attention, steady-state with device-to-host fetch as the sync barrier
+#: (2026-07-29, TPU_PROBES.log). Later rounds report vs_baseline against it.
+BASELINE_EXAMPLES_PER_S = 770.0
 
 #: seconds before the watchdog declares the accelerator unreachable (a wedged remote-TPU
 #: tunnel hangs jax backend init indefinitely; the driver still needs its JSON line)
@@ -124,7 +126,7 @@ def run_bench():
     if on_accelerator:
         config = BertConfig.base(dtype=jnp.bfloat16)
         batch_sizes = (32, 16, 8)
-        measure_steps, warmup_steps = 10, 2
+        measure_steps, warmup_steps = 20, 3
     else:  # keep the CPU path runnable for smoke testing
         config = BertConfig.tiny(dtype=jnp.float32, attention_impl="xla")
         batch_sizes = (8,)
@@ -151,12 +153,15 @@ def run_bench():
             }
             for _ in range(warmup_steps):
                 state, metrics = step(state, batch)
-            jax.block_until_ready(metrics["loss"])
+            # device-to-host fetch, NOT block_until_ready: remote-TPU platforms
+            # (axon) return from block_until_ready before execution finishes,
+            # which once produced a bogus 523% MFU (TPU_PROBES.log 2026-07-29)
+            float(metrics["loss"])
 
             t0 = time.perf_counter()
             for _ in range(measure_steps):
                 state, metrics = step(state, batch)
-            jax.block_until_ready(metrics["loss"])
+            float(metrics["loss"])
             elapsed = time.perf_counter() - t0
 
             examples_per_s = measure_steps * batch_size / elapsed

@@ -24,15 +24,17 @@ from datetime import datetime, timezone
 
 
 def _time(fn, *args, iters=20, warmup=3):
-    import jax
+    # hard_sync, not block_until_ready: the latter returns early on remote-TPU
+    # platforms (axon) — see TPU_PROBES.log 2026-07-29
+    from unionml_tpu.utils import hard_sync
 
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    hard_sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    hard_sync(out)
     return (time.perf_counter() - t0) / iters * 1e3  # ms/iter
 
 

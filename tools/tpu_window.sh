@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Run the full queued TPU measurement battery during a tunnel-up window.
+#
+# The remote-TPU tunnel (axon relay) has been up for only minutes at a time
+# (TPU_PROBES.log), so every hardware task is time-bounded and ordered by value:
+#   1. bench.py            — headline BERT-base fine-tune throughput + MFU
+#   2. bench_kernels.py    — pallas-vs-XLA block sweep -> KERNEL_BENCH.json
+#   3. bench_serving.py    — HTTP p50/p99 -> SERVING_BENCH.json
+# Each step's JSON artifact is committed by the caller if it changed.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+
+if ! timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+  echo "$STAMP tpu_window.sh: tunnel not live; aborting" >> TPU_PROBES.log
+  exit 1
+fi
+echo "$STAMP tpu_window.sh: tunnel LIVE, starting battery" >> TPU_PROBES.log
+
+run() {
+  local name=$1 tmo=$2; shift 2
+  local t0=$(date -u +%H:%M:%SZ)
+  if timeout "$tmo" "$@" > "/tmp/tpu_${name}.out" 2> "/tmp/tpu_${name}.err"; then
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: $name OK (started $t0): $(tail -1 /tmp/tpu_${name}.out)" >> TPU_PROBES.log
+  else
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: $name FAILED rc=$? (started $t0); see /tmp/tpu_${name}.err" >> TPU_PROBES.log
+  fi
+}
+
+run bench 420 python bench.py
+run kernels 900 python bench_kernels.py
+run serving 420 python bench_serving.py --bert-base
+echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log

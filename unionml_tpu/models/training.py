@@ -27,6 +27,7 @@ from unionml_tpu.parallel.mesh import (
     replicated,
     wrapped_row_indices,
 )
+from unionml_tpu.utils import hard_sync
 
 
 class TrainState(train_state.TrainState):
@@ -213,9 +214,10 @@ def fit(
             # copy=False feeds the loader's python-owned slot buffers straight to
             # device_put (zero host copies after the native gather) — safe ONLY for
             # real accelerators, where the transfer lands in separate device memory
-            # and block_until_ready fences it. The CPU backend may ALIAS an aligned
-            # host array instead of copying, so slot recycling would corrupt
-            # "transferred" batches — keep the host copy there.
+            # and hard_sync fences it (block_until_ready is not a real barrier on
+            # remote-TPU platforms — see utils.hard_sync). The CPU backend may ALIAS
+            # an aligned host array instead of copying, so slot recycling would
+            # corrupt "transferred" batches — keep the host copy there.
             zero_copy = jax.default_backend() != "cpu"
             for views in prefetch_loader.epoch(rng=epoch_rng, copy=not zero_copy):
                 if sharding is not None:
@@ -224,11 +226,13 @@ def fit(
                     if wrap is not None:  # ragged tail batch: wrap real rows to fit the mesh
                         views = {k: v[wrap] for k, v in views.items()}
                     batch = {k: jax.device_put(v, sharding) for k, v in views.items()}
-                    jax.block_until_ready(batch)
+                    if zero_copy:
+                        hard_sync(batch)
                     yield batch
                 else:
                     batch = {k: jax.device_put(v) for k, v in views.items()}
-                    jax.block_until_ready(batch)
+                    if zero_copy:
+                        hard_sync(batch)
                     yield batch
             return
         yield from dict_batches(data, batch_size, rng=epoch_rng, mesh=mesh)
@@ -251,7 +255,7 @@ def fit(
     # compile outside the timed region so wall-clock measures steady-state steps
     first_batch = next(iter(batch_iterator(rng)))
     state, metrics = step_fn(state, first_batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])  # host fetch = real barrier (see utils.hard_sync)
     step += 1
 
     t0 = time.perf_counter()
@@ -273,7 +277,7 @@ def fit(
                 break
         if done:
             break
-    jax.block_until_ready(state.params)
+    float(metrics["loss"])  # host fetch = real barrier for the timed region
     wall = time.perf_counter() - t0
     if checkpointer is not None:
         checkpointer.flush()

@@ -67,14 +67,15 @@ def _flash_kernel(
 ):
     """One (batch*head, q_block) program: stream KV blocks with an online softmax.
 
-    ``kv_len_ref`` is a scalar (SMEM) per-batch valid KV length implementing the
-    padding mask: K positions >= kv_len contribute nothing. When pallas passes a
-    second output ref (``lse_ref``), the per-row logsumexp is written as the backward
-    residual.
+    ``kv_len_ref`` is the whole (batch*heads,) valid-KV-length vector in SMEM
+    (Mosaic only allows rank-1 blocks that are whole-array or lane-tile multiples,
+    so it is passed unblocked and indexed by the grid's batch*head coordinate);
+    K positions >= kv_len contribute nothing. When pallas passes a second output
+    ref (``lse_ref``), the per-row logsumexp is written as the backward residual.
     """
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, head_dim)
     q_index = pl.program_id(1)
-    kv_len = kv_len_ref[0]
+    kv_len = kv_len_ref[pl.program_id(0)]
 
     acc = jnp.zeros((block_q, q.shape[-1]), dtype=jnp.float32)
     row_max = jnp.full((block_q, 1), _NEG_INF, dtype=jnp.float32)
@@ -164,13 +165,15 @@ def _flash_forward(
     out_shape = [jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))]
     if return_residuals:
-        out_shape.append(jax.ShapeDtypeStruct((bh, seq_q), jnp.float32))
-        out_specs.append(pl.BlockSpec((1, block_q), lambda b, i: (b, i)))
+        # trailing singleton keeps the block's last-two dims Mosaic-tileable:
+        # (block_q, 1) has last dim == array dim and block_q % 8 == 0
+        out_shape.append(jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)))
     result = pl.pallas_call(
         kernel,
         grid=(bh, seq_q // block_q),
         in_specs=[
-            pl.BlockSpec((1,), lambda b, i: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole kv_lens vector, unblocked
             pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
@@ -218,7 +221,7 @@ def _bwd_dq_kernel(
     lse = lse_ref[0].reshape(block_q, 1)
     delta = delta_ref[0].reshape(block_q, 1)
     q_index = pl.program_id(1)
-    kv_len = kv_len_ref[0]
+    kv_len = kv_len_ref[pl.program_id(0)]
 
     dq = jnp.zeros((block_q, qs.shape[-1]), dtype=jnp.float32)
     num_k_blocks = seq_k // block_k
@@ -269,7 +272,7 @@ def _bwd_dkv_kernel(
     k_block = k_ref[0].astype(jnp.float32)  # (block_k, d)
     v_block = v_ref[0].astype(jnp.float32)
     kv_index = pl.program_id(1)
-    kv_len = kv_len_ref[0]
+    kv_len = kv_len_ref[pl.program_id(0)]
 
     dk = jnp.zeros_like(k_block)
     dv = jnp.zeros_like(v_block)
@@ -334,9 +337,10 @@ def _flash_backward(
 
     reshape3 = lambda x: x.reshape(bh, x.shape[-2], x.shape[-1])
     q3, k3, v3, do3 = reshape3(q), reshape3(k), reshape3(v), reshape3(g)
-    lse3 = lse.reshape(bh, seq_q)
+    # trailing singleton: see the forward's residual out_spec comment
+    lse3 = lse.reshape(bh, seq_q, 1)
     # delta_i = rowsum(dO * O): the softmax-jacobian correction term
-    delta3 = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1).reshape(bh, seq_q)
+    delta3 = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1).reshape(bh, seq_q, 1)
     if kv_lens is None:
         kv_lens_bh = jnp.full((bh,), seq_k, dtype=jnp.int32)
     else:
@@ -349,13 +353,13 @@ def _flash_backward(
         dq_kernel,
         grid=(bh, seq_q // block_q),
         in_specs=[
-            pl.BlockSpec((1,), lambda b, i: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole kv_lens vector, unblocked
             pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
@@ -374,13 +378,14 @@ def _flash_backward(
         dkv_kernel,
         grid=(bh, seq_k // block_k),
         in_specs=[
-            pl.BlockSpec((1,), lambda b, j: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole kv_lens vector, unblocked
+
             pl.BlockSpec((1, seq_q, head_dim), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, seq_q, head_dim), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),

@@ -93,7 +93,9 @@ class ResidentPredictor:
                     "No warmup template (pass example_features to serve()); first request will compile."
                 )
                 return
-            jax.block_until_ready(self._compiled(self._device_model_object, example))
+            from unionml_tpu.utils import hard_sync
+
+            hard_sync(self._compiled(self._device_model_object, example))
             logger.info("Resident predictor warmed (bucket=%d).", self._buckets[0])
         except Exception as exc:
             # keep the compiled predictor: the synthetic example may simply have the

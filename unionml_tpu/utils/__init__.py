@@ -48,6 +48,28 @@ def is_sklearn_model(obj_or_type: Any) -> bool:
     return isinstance(obj_or_type, sklearn.base.BaseEstimator)
 
 
+def hard_sync(tree: Any) -> None:
+    """Block until every array in ``tree`` has finished computing — via device-to-host
+    fetches, not ``jax.block_until_ready``.
+
+    On remote-TPU platforms (the axon plugin) ``block_until_ready`` returns before
+    execution completes (observed 2026-07-29: a 10-step BERT timing loop "finished" in
+    0.02s — TPU_PROBES.log), so anything that needs a real barrier (benchmark timing,
+    zero-copy buffer recycling fences) must gate on a transfer instead. Fetching one
+    element PER ADDRESSABLE SHARD forces every device's producing computation (and
+    any pending host-to-device transfer it consumed) to complete — a whole-leaf
+    fetch would sync only the device holding element 0 of a sharded array.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for shard in shards:
+                if shard.data.size:
+                    jax.device_get(shard.data.ravel()[0])
+        elif hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+            jax.device_get(leaf.ravel()[0])
+
+
 def module_is_installed(module: str) -> bool:
     """``utils.py:71-76`` parity."""
     try:
