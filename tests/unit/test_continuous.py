@@ -1,0 +1,199 @@
+"""Continuous-batching decode engine: exactness vs the one-shot generate path.
+
+The gold property (mirrors the ragged-prompt guarantee in test_gpt.py): a request
+decoded through the slot engine — with OTHER requests inserted and evicted around
+it mid-flight — emits exactly the tokens it would emit alone through
+``models.gpt.generate``. Greedy, f32, tiny config, so equality is exact.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.models import GPTConfig, GPTLMHeadModel
+from unionml_tpu.models.gpt import generate, init_params
+from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+
+CONFIG = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    model = GPTLMHeadModel(CONFIG)
+    variables = init_params(CONFIG, seq_len=16)
+    return model, variables
+
+
+def solo(model, variables, prompt, n):
+    """Reference: the one-shot batch-1 generate path."""
+    ids = jnp.asarray(np.asarray(prompt, dtype=np.int32)[None])
+    out = generate(model, variables, ids, n)
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+def test_engine_single_request_matches_generate(gpt):
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(8, 16))
+    prompt = [3, 1, 4, 1, 5]
+    assert engine.generate(prompt, 6) == solo(model, variables, prompt, 6)
+
+
+def test_staggered_insertion_does_not_perturb_neighbors(gpt):
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=3, max_len=64, prefill_buckets=(4, 8, 16))
+    requests = [([3, 1, 4, 1, 5], 6), ([2, 7], 5), ([1, 8, 2, 8, 1, 8, 2, 8], 4)]
+    expected = [solo(model, variables, p, n) for p, n in requests]
+
+    collected = {}
+    slot_to_req = {}
+
+    def drain(events):
+        for ev in events:
+            if ev.emit:
+                collected.setdefault(slot_to_req[ev.slot], []).append(ev.token)
+
+    # request 0 decodes alone for 2 steps, then 1 joins, then 2 — insertions land
+    # BETWEEN steps of already-running requests
+    slot_to_req[engine.add_request(*requests[0])] = 0
+    drain(engine.step())
+    drain(engine.step())
+    slot_to_req[engine.add_request(*requests[1])] = 1
+    drain(engine.step())
+    slot_to_req[engine.add_request(*requests[2])] = 2
+    while engine.num_active:
+        drain(engine.step())
+
+    assert [collected[i] for i in range(3)] == expected
+
+
+def test_slot_reuse_after_finish(gpt):
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,))
+    first = engine.generate([5, 4, 3], 4)
+    second = engine.generate([9, 9, 1, 2], 5)  # reuses the single slot
+    assert first == solo(model, variables, [5, 4, 3], 4)
+    assert second == solo(model, variables, [9, 9, 1, 2], 5)
+
+
+def test_eos_stops_and_is_not_emitted(gpt):
+    model, variables = gpt
+    prompt = [3, 1, 4, 1, 5]
+    expected = solo(model, variables, prompt, 6)
+    eos = expected[2]
+    engine = DecodeEngine(
+        model, variables, num_slots=1, max_len=64, prefill_buckets=(8,), eos_token_id=eos
+    )
+    assert engine.generate(prompt, 6) == expected[: expected.index(eos)]
+
+
+def test_capacity_force_finish(gpt):
+    model, variables = gpt
+    prompt = [1, 2, 3, 4]
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=16, prefill_buckets=(4, 8))
+    out = engine.generate(prompt, 100)  # budget far beyond cache capacity
+    budget = 16 - 1 - len(prompt)
+    assert len(out) == budget
+    assert out == solo(model, variables, prompt, budget)
+
+
+def test_request_validation(gpt):
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=16, prefill_buckets=(4,))
+    with pytest.raises(ValueError, match="empty prompt"):
+        engine.add_request([], 4)
+    with pytest.raises(ValueError, match="largest prefill bucket"):
+        engine.add_request(list(range(9)), 4)
+    with pytest.raises(ValueError, match="max_len"):
+        engine.add_request(list(range(40)), 4)
+    engine.add_request([1, 2], 4)
+    with pytest.raises(RuntimeError, match="no free decode slots"):
+        engine.add_request([1, 2], 4)
+
+
+def test_per_row_positions_reject_multi_token(gpt):
+    model, variables = gpt
+    from unionml_tpu.models.gpt import init_cache
+
+    cache = init_cache(CONFIG, 2, 16)
+    with pytest.raises(ValueError, match="seq=1"):
+        model.apply(
+            variables,
+            jnp.zeros((2, 2), dtype=jnp.int32),
+            cache=cache,
+            position=jnp.zeros((2,), dtype=jnp.int32),
+        )
+
+
+def test_generate_route_over_http(gpt):
+    """POST /generate end to end: in-process aiohttp server + continuous batcher."""
+    import types
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from unionml_tpu.serving import build_aiohttp_app
+
+    model, variables = gpt
+    stub = types.SimpleNamespace(name="gen-app", artifact=object())
+    app = build_aiohttp_app(
+        stub,
+        resident=False,
+        coalesce=False,
+        generator=lambda: DecodeEngine(
+            model, variables, num_slots=2, max_len=64, prefill_buckets=(4, 8)
+        ),
+    )
+    expected_single = solo(model, variables, [3, 1, 4], 5)
+    expected_batch = [solo(model, variables, p, 4) for p in ([2, 7], [5, 5, 5])]
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post("/generate", json={"prompt_ids": [3, 1, 4], "max_new_tokens": 5})
+            assert resp.status == 200, await resp.text()
+            single = (await resp.json())["tokens"]
+
+            resp = await client.post(
+                "/generate", json={"prompts": [[2, 7], [5, 5, 5]], "max_new_tokens": 4}
+            )
+            assert resp.status == 200, await resp.text()
+            batch = (await resp.json())["completions"]
+
+            resp = await client.post("/generate", json={})
+            assert resp.status == 422
+
+            resp = await client.post(
+                "/generate", json={"prompt_ids": list(range(100)), "max_new_tokens": 4}
+            )
+            assert resp.status == 422
+
+            resp = await client.get("/stats")
+            stats = await resp.json()
+            assert stats["generation"]["num_slots"] == 2
+            return single, batch
+        finally:
+            await client.close()
+
+    single, batch = asyncio.run(main())
+    assert single == expected_single
+    assert batch == expected_batch
+
+
+def test_batcher_concurrent_requests_match_solo(gpt):
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(4, 8))
+    batcher = ContinuousBatcher(engine)
+    requests = [([3, 1, 4], 5), ([2, 7], 4), ([1, 8, 2, 8], 3), ([6], 6)]
+    expected = [solo(model, variables, p, n) for p, n in requests]
+
+    async def main():
+        return await asyncio.gather(*(batcher.generate(p, n) for p, n in requests))
+
+    try:
+        results = asyncio.run(main())
+    finally:
+        batcher.close()
+    assert results == expected

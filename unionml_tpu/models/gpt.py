@@ -79,9 +79,11 @@ class DecoderBlock(nn.Module):
 
         Incremental contract: ``hidden`` is (batch, 1, d); ``cache`` holds
         ``{"k","v"}`` of shape (batch, heads, max_len, head_dim) plus the write
-        ``position`` (scalar). ``pad_offsets`` is a (batch,) count of LEFT-pad tokens
-        per row (ragged-prompt batching): key positions below a row's offset are
-        masked for that row. Returns (hidden, new_cache).
+        ``position`` — a scalar (all rows at the same decode step) or a (batch,)
+        int vector (continuous batching: each row at its OWN step, writing its own
+        cache column; requires seq == 1). ``pad_offsets`` is a (batch,) count of
+        LEFT-pad tokens per row (ragged-prompt batching): key positions below a
+        row's offset are masked for that row. Returns (hidden, new_cache).
         """
         cfg = self.config
         batch, seq, _ = hidden.shape
@@ -118,10 +120,23 @@ class DecoderBlock(nn.Module):
                 context = xla_attention(q, k, v, causal=True, mask=pad_mask(jnp.arange(seq)))
             new_cache = None
         else:
-            # write the new K/V block at `position`; works for single-token decode
-            # (seq=1) AND chunked prefill (seq=prompt_len, position=0)
-            k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, 0, position, 0))
-            v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, 0, position, 0))
+            per_row = not isinstance(position, int) and jnp.ndim(position) == 1
+            if per_row and seq != 1:
+                raise ValueError("per-row cache positions require single-token decode (seq=1)")
+            if per_row:
+                # continuous batching: each row writes its next token's K/V at its
+                # own column (one scatter; out-of-range rows clamp to the last
+                # column, which the engine only allows for finished slots)
+                max_cache_len = cache["k"].shape[2]
+                cols = jnp.clip(position.astype(jnp.int32), 0, max_cache_len - 1)
+                rows = jnp.arange(batch)
+                k_cache = cache["k"].at[rows, :, cols, :].set(k[:, :, 0, :].astype(cache["k"].dtype))
+                v_cache = cache["v"].at[rows, :, cols, :].set(v[:, :, 0, :].astype(cache["v"].dtype))
+            else:
+                # write the new K/V block at `position`; works for single-token decode
+                # (seq=1) AND chunked prefill (seq=prompt_len, position=0)
+                k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, 0, position, 0))
+                v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, 0, position, 0))
             if seq > 1 and isinstance(position, int) and position == 0 and pad_offsets is None:
                 # start-of-sequence prefill: no earlier keys exist, so plain causal
                 # attention over the chunk (the flash kernel on TPU) is exact — no
@@ -135,10 +150,16 @@ class DecoderBlock(nn.Module):
                 context = xla_attention(q, k, v, causal=True, mask=pad_mask(jnp.arange(seq)))
             else:
                 # decode step / mid-sequence chunk: attend over the cache with a
-                # global-position causal mask (+ per-row left-pad mask when ragged)
-                q_pos = position + jnp.arange(seq)
+                # causal mask built from the write position(s) — shared scalar, or
+                # per-row columns (continuous batching: each row sees exactly its
+                # own [0, position_r] prefix) — plus the left-pad mask when ragged
                 k_pos = jnp.arange(k_cache.shape[2])
-                mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+                if per_row:
+                    q_pos = position[:, None] + jnp.arange(seq)[None, :]  # (batch, seq)
+                    mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, :, :]
+                else:
+                    q_pos = position + jnp.arange(seq)
+                    mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
                 if pad_offsets is not None:
                     mask = mask & pad_mask(k_pos)
                 context = xla_attention(q, k_cache, v_cache, mask=mask)
@@ -201,6 +222,10 @@ class GPTLMHeadModel(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="wte")
         if cache is None:
             positions = jnp.arange(seq)[None, :]
+        elif not isinstance(position, int) and jnp.ndim(position) == 1:
+            # per-row decode positions (continuous batching)
+            positions = (position[:, None] + jnp.arange(seq)[None, :]).astype(jnp.int32)
+            positions = jnp.clip(positions, 0, cfg.max_position_embeddings - 1)
         else:
             positions = (position + jnp.arange(seq))[None, :].astype(jnp.int32)
         if pad_offsets is not None:

@@ -3,6 +3,7 @@
 from typing import Any, Optional
 
 from unionml_tpu.serving.app import build_aiohttp_app, jsonable, load_model_artifact, run_app
+from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
 from unionml_tpu.serving.resident import ResidentPredictor
 
 
@@ -54,6 +55,8 @@ def serving_app(
 
 
 __all__ = [
+    "ContinuousBatcher",
+    "DecodeEngine",
     "ResidentPredictor",
     "build_aiohttp_app",
     "jsonable",

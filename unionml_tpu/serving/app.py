@@ -87,6 +87,7 @@ def build_aiohttp_app(
     buckets: Optional[Any] = None,
     seq_buckets: Optional[Any] = None,
     example_features: Optional[Any] = None,
+    generator: Optional[Any] = None,
 ):
     """Create the aiohttp application with a resident predictor.
 
@@ -97,6 +98,12 @@ def build_aiohttp_app(
     ``seq_buckets`` enables sequence-length bucketing for tokenized inputs, and
     ``example_features`` (a request-shaped row list) drives startup warmup for
     multi-input models — see :class:`ResidentPredictor`.
+
+    ``generator`` enables the continuous-batching ``POST /generate`` route for
+    decoder models: a :class:`~unionml_tpu.serving.continuous.DecodeEngine`, a
+    :class:`~unionml_tpu.serving.continuous.ContinuousBatcher`, or a zero-arg
+    callable returning either — the callable form is evaluated at startup, AFTER
+    the model artifact loads, so the engine can be built from trained variables.
     """
     from aiohttp import web
 
@@ -125,11 +132,22 @@ def build_aiohttp_app(
         load_model_artifact(model, remote=remote, app_version=app_version, model_version=model_version)
         if predictor is not None:
             predictor.setup()
+        if generator is not None:
+            from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+
+            built = generator() if callable(generator) and not isinstance(
+                generator, (DecodeEngine, ContinuousBatcher)
+            ) else generator
+            if isinstance(built, DecodeEngine):
+                built = ContinuousBatcher(built)
+            app["continuous_batcher"] = built
         logger.info("Serving app ready (model=%s).", model.name)
 
     async def on_cleanup(app):
         if batcher is not None:
             batcher.close()
+        if app.get("continuous_batcher") is not None:
+            app["continuous_batcher"].close()
 
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
@@ -183,8 +201,47 @@ def build_aiohttp_app(
             logger.exception("Prediction failed")
             return web.json_response({"detail": f"Prediction failed: {exc}"}, status=500)
 
+    async def generate_route(request):
+        gen = request.app.get("continuous_batcher")
+        if gen is None:
+            return web.json_response({"detail": "Generation is not enabled on this app."}, status=404)
+        try:
+            payload = await request.json()
+        except Exception:
+            return web.json_response({"detail": "Request body must be JSON."}, status=422)
+        max_new = payload.get("max_new_tokens", 32)
+        prompt_ids = payload.get("prompt_ids")
+        prompts = payload.get("prompts")
+        if prompt_ids is None and prompts is None:
+            return web.json_response(
+                {"detail": "prompt_ids (one prompt) or prompts (a batch) must be supplied."},
+                status=422,
+            )
+        import asyncio
+
+        try:
+            if prompt_ids is not None:
+                tokens = await gen.generate(prompt_ids, int(max_new))
+                return web.json_response({"tokens": tokens})
+            completions = await asyncio.gather(
+                *(gen.generate(p, int(max_new)) for p in prompts)
+            )
+            return web.json_response({"completions": list(completions)})
+        except ValueError as exc:  # bad request (empty/oversized prompt, bad budget)
+            return web.json_response({"detail": str(exc)}, status=422)
+        except Exception as exc:  # engine/worker failures are SERVER errors
+            logger.exception("Generation failed")
+            return web.json_response({"detail": f"Generation failed: {exc}"}, status=500)
+
     async def stats(request):
         payload = {"model": model.name, "resident": predictor is not None}
+        gen = request.app.get("continuous_batcher")
+        if gen is not None:
+            payload["generation"] = {
+                "num_slots": gen.engine.num_slots,
+                "active": gen.engine.num_active,
+                "max_len": gen.engine.max_len,
+            }
         if batcher is not None:
             payload["coalescing"] = dict(batcher.stats)
             if batcher.ema_gap_ms is not None:
@@ -195,6 +252,7 @@ def build_aiohttp_app(
     app.router.add_get("/health", health)
     app.router.add_get("/stats", stats)
     app.router.add_post("/predict", predict)
+    app.router.add_post("/generate", generate_route)
     app["unionml_model"] = model
     app["resident_predictor"] = predictor
     app["request_batcher"] = batcher

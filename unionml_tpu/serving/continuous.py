@@ -1,0 +1,354 @@
+"""Continuous batching for the GPT generation serving path.
+
+The reference serves predictions one request at a time through FastAPI
+(``unionml/fastapi.py:50-64``); its hot loop is a single predictor call. For
+autoregressive generation that design wastes the accelerator: a new request must
+wait for every in-flight generation to finish. Continuous batching — the
+vLLM/Orca serving discipline — keeps ONE compiled decode step running over a
+fixed set of slots, inserting incoming requests into free slots *between steps*
+and evicting finished ones, so throughput stays at batch-decode levels while
+per-request latency stays at single-request levels.
+
+TPU-first shape discipline: everything the device sees is static.
+
+- The KV cache is a ``(num_slots, heads, max_len, head_dim)`` pytree allocated
+  once. A request occupies one slot; its cache rows are dense in ``[0, len)``.
+- Each slot decodes at its OWN position: the decode step passes ``position`` as
+  a ``(num_slots,)`` vector and the model scatters each row's K/V into its own
+  column (see ``DecoderBlock`` per-row positions, ``models/gpt.py``). No global
+  column counter, no gaps, no compaction; a freed slot is reusable immediately
+  because a new request's mask (``k_pos <= position_r``) never reaches stale
+  columns before its own decode overwrites them.
+- Prefill runs per request at batch 1, padded right to a small set of bucket
+  lengths (one compile per bucket), then one ``dynamic_update_slice`` per layer
+  copies the bucket into the slot's cache rows.
+- The decode step jit-compiles exactly once per engine (all shapes fixed).
+
+``DecodeEngine`` is the synchronous core (useful directly in scripts/tests);
+``ContinuousBatcher`` runs it on a worker thread behind an asyncio API for the
+serving app's ``/generate`` route.
+"""
+
+import asyncio
+import collections
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from unionml_tpu._logging import logger
+
+#: default prompt-prefill bucket lengths (right-padded; one XLA compile each)
+DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepEvent:
+    """One slot's outcome for one engine step."""
+
+    slot: int
+    token: int
+    #: False for an EOS token (consumed, not part of the completion)
+    emit: bool
+    finished: bool
+
+
+class DecodeEngine:
+    """Slot-based continuous-batching decode engine over a GPT-style model.
+
+    :param model: a :class:`~unionml_tpu.models.gpt.GPTLMHeadModel` (anything with
+        ``.config`` and ``.apply(variables, ids, cache=, position=)`` matching its
+        incremental contract).
+    :param variables: trained model variables (``{"params": ...}``).
+    :param num_slots: concurrent sequences held on device (the decode batch).
+    :param max_len: per-slot cache capacity (prompt + generated tokens). A slot
+        force-finishes when its length reaches ``max_len - 1``.
+    :param eos_token_id: token that terminates a completion (not emitted).
+    :param temperature: 0 = greedy (exactly reproduces
+        :func:`unionml_tpu.models.gpt.generate` row by row); > 0 samples — note
+        sampled streams depend on engine scheduling order, unlike ``generate``.
+    :param prefill_buckets: allowed padded prompt lengths; prompts longer than the
+        largest bucket (or ``max_len``) are rejected with ``ValueError``.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        variables: Any,
+        *,
+        num_slots: int = 8,
+        max_len: Optional[int] = None,
+        eos_token_id: Optional[int] = None,
+        temperature: float = 0.0,
+        prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+        seed: int = 0,
+    ) -> None:
+        from unionml_tpu.models.gpt import init_cache
+
+        config = model.config
+        max_len = max_len or config.max_position_embeddings
+        if max_len > config.max_position_embeddings:
+            raise ValueError(
+                f"max_len ({max_len}) exceeds max_position_embeddings "
+                f"({config.max_position_embeddings})"
+            )
+        self._model = model
+        self._variables = variables
+        self._config = config
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self._buckets = tuple(sorted(b for b in prefill_buckets if b < max_len)) or (max_len - 1,)
+
+        self._cache = init_cache(config, num_slots, max_len)
+        self._lens = jnp.zeros((num_slots,), jnp.int32)
+        self._last_logits = jnp.zeros((num_slots, config.vocab_size), jnp.float32)
+        self._key = jax.random.PRNGKey(seed)
+
+        # host mirrors (authoritative for scheduling; device arrays follow them)
+        self._active = np.zeros(num_slots, dtype=bool)
+        self._lens_host = np.zeros(num_slots, dtype=np.int64)
+        self._remaining = np.zeros(num_slots, dtype=np.int64)
+
+        temperature_ = self.temperature
+
+        def _step(variables, cache, last_logits, lens, active, key):
+            key, subkey = jax.random.split(key)
+            if temperature_ <= 0.0:
+                tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            else:
+                tokens = jax.random.categorical(
+                    subkey, last_logits / temperature_, axis=-1
+                ).astype(jnp.int32)
+            logits, cache = model.apply(variables, tokens[:, None], cache=cache, position=lens)
+            # inactive rows freeze: length and logits unchanged, their (ignored)
+            # cache write lands on a column their own future prefill/decode rewrites
+            new_lens = jnp.where(active, jnp.minimum(lens + 1, max_len - 1), lens)
+            new_logits = jnp.where(active[:, None], logits[:, -1, :], last_logits)
+            return cache, new_logits, new_lens, tokens, key
+
+        self._step_fn = jax.jit(_step, donate_argnums=(1, 2))
+
+        def _prefill(variables, prompt_ids, length):
+            local_cache = init_cache(config, 1, prompt_ids.shape[1])
+            logits, local_cache = model.apply(variables, prompt_ids, cache=local_cache, position=0)
+            # right padding + causal attention: the logits at the last REAL token
+            # are unaffected by the padded tail
+            return local_cache, jnp.take(logits[0], length - 1, axis=0)
+
+        self._prefill_fn = jax.jit(_prefill)  # re-traces per bucket shape (bounded)
+
+        def _insert(cache, lens, last_logits, local_cache, local_logits, slot, length):
+            def put(full, local):
+                return jax.lax.dynamic_update_slice(full, local.astype(full.dtype), (slot, 0, 0, 0))
+
+            cache = jax.tree_util.tree_map(put, cache, local_cache)
+            return (
+                cache,
+                lens.at[slot].set(length),
+                last_logits.at[slot].set(local_logits.astype(jnp.float32)),
+            )
+
+        self._insert_fn = jax.jit(_insert, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------ scheduling
+
+    @property
+    def free_slots(self) -> List[int]:
+        return [int(s) for s in np.flatnonzero(~self._active)]
+
+    @property
+    def num_active(self) -> int:
+        return int(self._active.sum())
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for bucket in self._buckets:
+            if bucket >= prompt_len:
+                return bucket
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill bucket "
+            f"({self._buckets[-1]}); raise prefill_buckets/max_len or truncate"
+        )
+
+    def add_request(self, prompt_ids: Sequence[int], max_new_tokens: int) -> int:
+        """Prefill ``prompt_ids`` into a free slot; returns the slot index.
+
+        Raises ``RuntimeError`` when no slot is free (callers should gate on
+        ``free_slots``) and ``ValueError`` for empty/oversized prompts. The
+        effective budget is capped by cache capacity: generation force-finishes
+        when the slot's length reaches ``max_len - 1``.
+        """
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size >= self.max_len:
+            raise ValueError(f"prompt length {prompt.size} >= max_len ({self.max_len})")
+        free = self.free_slots
+        if not free:
+            raise RuntimeError("no free decode slots")
+        slot = free[0]
+        bucket = self.bucket_for(prompt.size)
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, : prompt.size] = prompt
+        local_cache, local_logits = self._prefill_fn(
+            self._variables, jnp.asarray(padded), prompt.size
+        )
+        self._cache, self._lens, self._last_logits = self._insert_fn(
+            self._cache, self._lens, self._last_logits, local_cache, local_logits,
+            slot, prompt.size,
+        )
+        self._active[slot] = True
+        self._lens_host[slot] = prompt.size
+        self._remaining[slot] = max_new_tokens
+        return slot
+
+    def step(self) -> List[StepEvent]:
+        """Decode one token for every active slot; returns per-slot events."""
+        if not self._active.any():
+            return []
+        active_dev = jnp.asarray(self._active)
+        self._cache, self._last_logits, self._lens, tokens, self._key = self._step_fn(
+            self._variables, self._cache, self._last_logits, self._lens, active_dev, self._key
+        )
+        tokens_host = np.asarray(jax.device_get(tokens))  # hard sync (see utils.hard_sync)
+        events: List[StepEvent] = []
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            token = int(tokens_host[slot])
+            self._remaining[slot] -= 1
+            self._lens_host[slot] = min(self._lens_host[slot] + 1, self.max_len - 1)
+            is_eos = self.eos_token_id is not None and token == self.eos_token_id
+            finished = (
+                is_eos
+                or self._remaining[slot] <= 0
+                or self._lens_host[slot] >= self.max_len - 1
+            )
+            if finished:
+                self._active[slot] = False
+            events.append(StepEvent(slot=slot, token=token, emit=not is_eos, finished=finished))
+        return events
+
+    def abort_all(self) -> None:
+        """Deactivate every slot (in-flight state is abandoned; cache reuse is safe)."""
+        self._active[:] = False
+
+    def generate(self, prompt_ids: Sequence[int], max_new_tokens: int) -> List[int]:
+        """Single-request convenience driver (tests/scripts): run one request to
+        completion on an otherwise-idle engine and return its emitted tokens."""
+        slot = self.add_request(prompt_ids, max_new_tokens)
+        out: List[int] = []
+        while self._active[slot]:
+            for event in self.step():
+                if event.slot == slot and event.emit:
+                    out.append(event.token)
+        return out
+
+
+class ContinuousBatcher:
+    """Asyncio facade running a :class:`DecodeEngine` on a worker thread.
+
+    ``await generate(prompt_ids, max_new_tokens)`` enqueues a request; the worker
+    admits queued requests into free slots between decode steps and resolves each
+    future with the completed token list. One engine step at a time, no step
+    blocking the event loop.
+    """
+
+    def __init__(self, engine: DecodeEngine) -> None:
+        self._engine = engine
+        self._pending: "collections.deque[Tuple[np.ndarray, int, asyncio.Future, asyncio.AbstractEventLoop]]" = (
+            collections.deque()
+        )
+        self._results: Dict[int, Tuple[List[int], asyncio.Future, asyncio.AbstractEventLoop]] = {}
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._closed = False
+        self._worker: Optional[threading.Thread] = None
+
+    @property
+    def engine(self) -> DecodeEngine:
+        return self._engine
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(target=self._run, name="continuous-batcher", daemon=True)
+            self._worker.start()
+
+    async def generate(self, prompt_ids: Sequence[int], max_new_tokens: int) -> List[int]:
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        # surface bad requests on the caller's side, not the worker's
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        self._engine.bucket_for(prompt.size)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._pending.append((prompt, int(max_new_tokens), future, loop))
+        self._ensure_worker()
+        self._work.set()
+        return await future
+
+    def _admit(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending or not self._engine.free_slots:
+                    return
+                prompt, budget, future, loop = self._pending.popleft()
+            try:
+                slot = self._engine.add_request(prompt, budget)
+            except Exception as exc:  # reject this request, keep serving others
+                loop.call_soon_threadsafe(future.set_exception, exc)
+                continue
+            self._results[slot] = ([], future, loop)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed and not self._pending and not self._results:
+                    return
+            self._admit()
+            if self._engine.num_active == 0:
+                self._work.clear()
+                # re-check under the flag: a request may have landed just now
+                with self._lock:
+                    if self._pending or self._closed:
+                        continue
+                self._work.wait(timeout=0.5)
+                continue
+            try:
+                events = self._engine.step()
+            except Exception as exc:  # fail every in-flight request loudly
+                logger.exception("continuous-batching step failed")
+                for slot, (_, future, loop) in list(self._results.items()):
+                    loop.call_soon_threadsafe(
+                        lambda f=future, e=exc: f.done() or f.set_exception(RuntimeError(str(e)))
+                    )
+                self._results.clear()
+                self._engine.abort_all()
+                continue
+            for event in events:
+                entry = self._results.get(event.slot)
+                if entry is None:
+                    continue
+                tokens, future, loop = entry
+                if event.emit:
+                    tokens.append(event.token)
+                if event.finished:
+                    del self._results[event.slot]
+                    loop.call_soon_threadsafe(
+                        lambda f=future, t=list(tokens): f.done() or f.set_result(t)
+                    )
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._work.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
