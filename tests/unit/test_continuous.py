@@ -127,6 +127,35 @@ def test_per_row_positions_reject_multi_token(gpt):
         )
 
 
+def test_step_failure_resets_engine(gpt):
+    """A device failure mid-step (donated buffers poisoned) must not brick the
+    engine: step() resets device + host state, raises, and the next request
+    decodes correctly from scratch."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(8,))
+    engine.add_request([3, 1, 4], 5)
+
+    real_step = engine._step_fn
+
+    def exploding(*args, **kwargs):
+        raise RuntimeError("synthetic device failure")
+
+    engine._step_fn = exploding
+    with pytest.raises(RuntimeError, match="synthetic device failure"):
+        engine.step()
+    engine._step_fn = real_step
+
+    assert engine.num_active == 0  # in-flight request abandoned
+    assert engine.generate([3, 1, 4], 5) == solo(model, variables, [3, 1, 4], 5)
+
+
+def test_bucket_equal_to_max_len_is_usable(gpt):
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=16, prefill_buckets=(16,))
+    prompt = list(range(1, 11))  # length 10 needs the 16 bucket
+    assert engine.generate(prompt, 3) == solo(model, variables, prompt, 3)
+
+
 def test_generate_route_over_http(gpt):
     """POST /generate end to end: in-process aiohttp server + continuous batcher."""
     import types
@@ -169,6 +198,20 @@ def test_generate_route_over_http(gpt):
                 "/generate", json={"prompt_ids": list(range(100)), "max_new_tokens": 4}
             )
             assert resp.status == 422
+
+            resp = await client.post(
+                "/generate", json={"prompt_ids": [1, 2], "max_new_tokens": [32]}
+            )
+            assert resp.status == 422  # malformed budget is a client error, not a 500
+
+            # one bad prompt rejects the whole batch BEFORE any slot is scheduled
+            resp = await client.post(
+                "/generate",
+                json={"prompts": [[2, 7], list(range(100))], "max_new_tokens": 4},
+            )
+            assert resp.status == 422
+            resp = await client.get("/stats")
+            assert (await resp.json())["generation"]["active"] == 0
 
             resp = await client.get("/stats")
             stats = await resp.json()

@@ -175,3 +175,43 @@ def test_fit_prefetch_convert_handles_raw_pandas_dtypes():
             prefetch_convert={"typo": "float32"})
     with pytest.raises(ValueError, match="requires prefetch=True"):
         fit(state, data, batch_size=32, num_epochs=1, prefetch_convert={"inputs": "float32"})
+
+
+def test_prefetch_deferred_release_lookahead():
+    """defer_release=True: a held (unreleased) batch stays intact while the
+    consumer pulls ahead — the transfer-overlap contract fit() relies on."""
+    data = _data()
+    loader = PrefetchLoader(data, batch_size=64, n_slots=4, n_threads=2)
+    perm = np.random.default_rng(11).permutation(512).astype(np.int64)
+
+    gen = loader.epoch(rng=np.random.default_rng(11), copy=False, defer_release=True)
+    held = []
+    for _ in range(3):  # hold 3 of 4 slots unreleased while pulling ahead
+        held.append(next(gen))
+    for b, (views, _) in enumerate(held):
+        idx = perm[b * 64 : (b + 1) * 64]
+        np.testing.assert_array_equal(views["x"], data["x"][idx])
+    for views, release in held:
+        release()
+        release()  # idempotent
+    seen = 3
+    for views, release in gen:
+        idx = perm[seen * 64 : (seen + 1) * 64]
+        np.testing.assert_array_equal(views["x"], data["x"][idx])
+        release()
+        seen += 1
+    assert seen == 8
+    loader.close()
+
+
+def test_prefetch_deferred_release_python_fallback():
+    """The pure-python gather path honors the (views, release) contract too."""
+    data = {k: v[:40] for k, v in _data().items()}
+    loader = PrefetchLoader(data, batch_size=16, n_slots=2, n_threads=1, drop_remainder=False)
+    pairs = list(loader.epoch(rng=np.random.default_rng(3), copy=True, defer_release=True))
+    reference = list(loader.epoch(rng=np.random.default_rng(3), copy=True))
+    assert len(pairs) == len(reference)
+    for (views, release), ref in zip(pairs, reference):
+        np.testing.assert_array_equal(views["x"], ref["x"])
+        release()
+    loader.close()

@@ -219,21 +219,36 @@ def fit(
             # an aligned host array instead of copying, so slot recycling would
             # corrupt "transferred" batches — keep the host copy there.
             zero_copy = jax.default_backend() != "cpu"
-            for views in prefetch_loader.epoch(rng=epoch_rng, copy=not zero_copy):
-                if sharding is not None:
-                    n = len(next(iter(views.values())))
-                    wrap = wrapped_row_indices(n, axis)
-                    if wrap is not None:  # ragged tail batch: wrap real rows to fit the mesh
-                        views = {k: v[wrap] for k, v in views.items()}
-                    batch = {k: jax.device_put(v, sharding) for k, v in views.items()}
-                    if zero_copy:
-                        hard_sync(batch)
+
+            def transfers():
+                # deferred slot release lets batch N+1's host->device transfer fly
+                # while step N computes: the slot recycles only after hard_sync
+                # proves its transfer landed
+                for views, release in prefetch_loader.epoch(
+                    rng=epoch_rng, copy=not zero_copy, defer_release=True
+                ):
+                    if sharding is not None:
+                        n = len(next(iter(views.values())))
+                        wrap = wrapped_row_indices(n, axis)
+                        if wrap is not None:  # ragged tail: wrap real rows to fit the mesh
+                            views = {k: v[wrap] for k, v in views.items()}
+                        yield {k: jax.device_put(v, sharding) for k, v in views.items()}, release
+                    else:
+                        yield {k: jax.device_put(v) for k, v in views.items()}, release
+
+            pending = None
+            for batch_and_release in transfers():
+                if pending is not None:
+                    batch, release = pending
+                    hard_sync(batch)
+                    release()
                     yield batch
-                else:
-                    batch = {k: jax.device_put(v) for k, v in views.items()}
-                    if zero_copy:
-                        hard_sync(batch)
-                    yield batch
+                pending = batch_and_release
+            if pending is not None:
+                batch, release = pending
+                hard_sync(batch)
+                release()
+                yield batch
             return
         yield from dict_batches(data, batch_size, rng=epoch_rng, mesh=mesh)
 

@@ -10,7 +10,7 @@ import os
 import subprocess
 import threading
 from pathlib import Path
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -224,16 +224,25 @@ class PrefetchLoader:
         return out
 
     def epoch(
-        self, rng: Optional[np.random.Generator] = None, copy: bool = True
-    ) -> Iterator[Dict[str, np.ndarray]]:
+        self,
+        rng: Optional[np.random.Generator] = None,
+        copy: bool = True,
+        defer_release: bool = False,
+    ) -> Iterator[Any]:
         """Yield one epoch of dict batches in shuffled order.
 
         ``copy=True`` (default) yields loader-independent arrays: safe for any
         consumer, including fully-async device transfers. ``copy=False`` yields the
         python-owned slot arrays themselves — ZERO host copies after the worker
         gather — which recycle after the generator resumes: the consumer must finish
-        reading (e.g. ``jax.block_until_ready`` on the device transfer) inside the
-        loop body.
+        reading (e.g. a ``hard_sync`` on the device transfer) inside the loop body.
+
+        ``defer_release=True`` yields ``(views, release)`` pairs instead: the slot
+        is recycled only when ``release()`` is called, so a consumer may hold a
+        batch (e.g. an in-flight device transfer) while pulling the next one —
+        the transfer-overlap lookahead ``fit()`` uses. Releases should happen in
+        yield order; holding more than ``n_slots - 1`` unreleased batches stalls
+        the gather workers.
         """
         indices = np.arange(self.n_rows, dtype=np.int64) if rng is None else rng.permutation(self.n_rows).astype(np.int64)
         # the native path only ever gathers FULL batches (its buffers are fixed-size);
@@ -242,16 +251,20 @@ class PrefetchLoader:
         n_full = self.n_rows // self.batch_size
         remainder = self.n_rows - n_full * self.batch_size
 
+        def emit(views, release=None):
+            # python-gathered batches are fresh arrays: release is a no-op
+            return (views, release or (lambda: None)) if defer_release else views
+
         def tail_batches():
             if not self.drop_remainder and remainder:
-                yield self._python_batch(indices[n_full * self.batch_size :])
+                yield emit(self._python_batch(indices[n_full * self.batch_size :]))
             elif n_full == 0:
                 # degenerate tiny datasets always yield their one true batch
-                yield self._python_batch(indices)
+                yield emit(self._python_batch(indices))
 
         if self._handle is None or n_full == 0:
             for b in range(n_full):
-                yield self._python_batch(indices[b * self.batch_size : (b + 1) * self.batch_size])
+                yield emit(self._python_batch(indices[b * self.batch_size : (b + 1) * self.batch_size]))
             yield from tail_batches()
             return
 
@@ -276,8 +289,18 @@ class PrefetchLoader:
                     key: (np.array(buf) if copy else buf)
                     for key, buf in zip(self._keys, slot)
                 }
-                yield views
-                self._lib.upf_release(self._handle, batch)
+                if defer_release:
+                    released = [False]
+
+                    def release(b=batch, flag=released):
+                        if not flag[0] and self._handle is not None:
+                            flag[0] = True
+                            self._lib.upf_release(self._handle, b)
+
+                    yield views, release
+                else:
+                    yield views
+                    self._lib.upf_release(self._handle, batch)
             yield from tail_batches()
         finally:
             del indices_c

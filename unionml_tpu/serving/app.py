@@ -209,7 +209,6 @@ def build_aiohttp_app(
             payload = await request.json()
         except Exception:
             return web.json_response({"detail": "Request body must be JSON."}, status=422)
-        max_new = payload.get("max_new_tokens", 32)
         prompt_ids = payload.get("prompt_ids")
         prompts = payload.get("prompts")
         if prompt_ids is None and prompts is None:
@@ -220,11 +219,26 @@ def build_aiohttp_app(
         import asyncio
 
         try:
+            max_new = int(payload.get("max_new_tokens", 32))
+        except (TypeError, ValueError):
+            return web.json_response({"detail": "max_new_tokens must be an integer."}, status=422)
+
+        try:
+            # validate EVERY prompt before scheduling any: a bad prompt in a
+            # batch must not leave its siblings burning decode slots for a
+            # response that will never be delivered
+            for p in [prompt_ids] if prompt_ids is not None else prompts:
+                seq = np.asarray(p, dtype=np.int32).reshape(-1)
+                if seq.size == 0:
+                    raise ValueError("empty prompt")
+                if seq.size >= gen.engine.max_len:
+                    raise ValueError(f"prompt length {seq.size} >= max_len ({gen.engine.max_len})")
+                gen.engine.bucket_for(seq.size)
             if prompt_ids is not None:
-                tokens = await gen.generate(prompt_ids, int(max_new))
+                tokens = await gen.generate(prompt_ids, max_new)
                 return web.json_response({"tokens": tokens})
             completions = await asyncio.gather(
-                *(gen.generate(p, int(max_new)) for p in prompts)
+                *(gen.generate(p, max_new) for p in prompts)
             )
             return web.json_response({"completions": list(completions)})
         except ValueError as exc:  # bad request (empty/oversized prompt, bad budget)

@@ -102,7 +102,9 @@ class DecodeEngine:
         self.max_len = max_len
         self.eos_token_id = eos_token_id
         self.temperature = float(temperature)
-        self._buckets = tuple(sorted(b for b in prefill_buckets if b < max_len)) or (max_len - 1,)
+        # a bucket equal to max_len is fine: prompts are < max_len and the padded
+        # prefill occupies exactly the slot's cache columns
+        self._buckets = tuple(sorted(b for b in prefill_buckets if b <= max_len)) or (max_len - 1,)
 
         self._cache = init_cache(config, num_slots, max_len)
         self._lens = jnp.zeros((num_slots,), jnp.int32)
@@ -208,15 +210,40 @@ class DecodeEngine:
         self._remaining[slot] = max_new_tokens
         return slot
 
+    def reset(self) -> None:
+        """Reallocate device state and clear all slots.
+
+        Required after a failed :meth:`step`: the step donates the cache/logits
+        buffers, so a deferred device error (surfacing at the token fetch, after
+        the state variables were already reassigned) leaves them poisoned and out
+        of sync with the host mirrors. In-flight requests are abandoned.
+        """
+        from unionml_tpu.models.gpt import init_cache
+
+        self._cache = init_cache(self._config, self.num_slots, self.max_len)
+        self._lens = jnp.zeros((self.num_slots,), jnp.int32)
+        self._last_logits = jnp.zeros((self.num_slots, self._config.vocab_size), jnp.float32)
+        self._active[:] = False
+        self._lens_host[:] = 0
+        self._remaining[:] = 0
+
     def step(self) -> List[StepEvent]:
-        """Decode one token for every active slot; returns per-slot events."""
+        """Decode one token for every active slot; returns per-slot events.
+
+        A device failure mid-step resets the engine (see :meth:`reset`) and
+        re-raises; every in-flight request is lost but the engine stays usable.
+        """
         if not self._active.any():
             return []
         active_dev = jnp.asarray(self._active)
-        self._cache, self._last_logits, self._lens, tokens, self._key = self._step_fn(
-            self._variables, self._cache, self._last_logits, self._lens, active_dev, self._key
-        )
-        tokens_host = np.asarray(jax.device_get(tokens))  # hard sync (see utils.hard_sync)
+        try:
+            self._cache, self._last_logits, self._lens, tokens, self._key = self._step_fn(
+                self._variables, self._cache, self._last_logits, self._lens, active_dev, self._key
+            )
+            tokens_host = np.asarray(jax.device_get(tokens))  # hard sync (see utils.hard_sync)
+        except Exception:
+            self.reset()
+            raise
         events: List[StepEvent] = []
         for slot in np.flatnonzero(self._active):
             slot = int(slot)
