@@ -149,6 +149,30 @@ def test_step_failure_resets_engine(gpt):
     assert engine.generate([3, 1, 4], 5) == solo(model, variables, [3, 1, 4], 5)
 
 
+def test_step_failure_after_state_assignment_recovers_key(gpt):
+    """The deferred-error shape: the step's tuple assignment completes (every
+    state var, including the PRNG key, now references poisoned outputs) before
+    the token fetch raises. reset() must rebuild the key too."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,))
+    engine.add_request([3, 1, 4], 5)
+
+    real_step = engine._step_fn
+
+    def poisoning(variables_, cache, logits, lens, active, key):
+        # state vars get assigned garbage, THEN the fetch path raises
+        engine._key = object()  # stands in for a poisoned device array
+        raise RuntimeError("deferred device failure")
+
+    engine._step_fn = poisoning
+    with pytest.raises(RuntimeError, match="deferred device failure"):
+        engine.step()
+    engine._step_fn = real_step
+
+    assert type(engine._key) is not object  # fresh jax key, not the poisoned stand-in
+    assert engine.generate([3, 1, 4], 5) == solo(model, variables, [3, 1, 4], 5)
+
+
 def test_bucket_equal_to_max_len_is_usable(gpt):
     model, variables = gpt
     engine = DecodeEngine(model, variables, num_slots=1, max_len=16, prefill_buckets=(16,))
@@ -203,6 +227,14 @@ def test_generate_route_over_http(gpt):
                 "/generate", json={"prompt_ids": [1, 2], "max_new_tokens": [32]}
             )
             assert resp.status == 422  # malformed budget is a client error, not a 500
+
+            resp = await client.post(
+                "/generate", json={"prompt_ids": [1, None], "max_new_tokens": 4}
+            )
+            assert resp.status == 422  # non-numeric token is a client error
+
+            resp = await client.post("/generate", json={"prompts": 123, "max_new_tokens": 4})
+            assert resp.status == 422  # non-list prompts is a client error
 
             # one bad prompt rejects the whole batch BEFORE any slot is scheduled
             resp = await client.post(

@@ -7,7 +7,13 @@ collectives — the TPU-native replacement for an NCCL/MPI backend (SURVEY.md §
 
 from unionml_tpu.parallel.dp import batches, data_parallel_eval, data_parallel_step, pad_to_multiple
 from unionml_tpu.parallel.ep import expert_sharding, moe_apply, moe_apply_capacity, moe_apply_topk
-from unionml_tpu.parallel.pp import superstage, pipeline_apply, stage_sharding
+from unionml_tpu.parallel.pp import (
+    circular_superstage,
+    pipeline_apply,
+    pipeline_apply_circular,
+    stage_sharding,
+    superstage,
+)
 from unionml_tpu.parallel.ring import ring_attention, sequence_sharding
 from unionml_tpu.parallel.ulysses import ulysses_attention
 from unionml_tpu.parallel.mesh import (
@@ -39,7 +45,9 @@ __all__ = [
     "moe_apply",
     "moe_apply_capacity",
     "moe_apply_topk",
+    "circular_superstage",
     "pipeline_apply",
+    "pipeline_apply_circular",
     "sp_attention",
     "superstage",
     "stage_sharding",

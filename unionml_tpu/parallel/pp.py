@@ -149,6 +149,178 @@ def pipeline_apply(
     return out_mb.reshape((batch,) + x.shape[1:])
 
 
+def _circular_local(
+    stage_params, inp, *, stage_fn, axis_name: str, num_microbatches: int, rounds: int
+):
+    """Per-device circular (interleaved) schedule.
+
+    Device d owns ``rounds`` stage-chunks: virtual stages d, d+D, d+2D, … of an
+    L = rounds*D virtual pipeline. Activations hand off around a RING (device
+    D-1 wraps to device 0 with the round index advancing), and each tick a
+    device applies the chunk its current job calls for via a dynamic index into
+    its stacked chunk params — same SPMD program on every device, no divergent
+    control flow. Job timing: device d's j-th busy tick (j = t - d) runs chunk
+    ``(j // D) % rounds`` for microbatch ``(j // (rounds*D))*D + j % D``;
+    total ticks = M*rounds + D - 1, so the fill/drain bubble is
+    (D-1)/(M*rounds + D-1) — ``rounds`` times smaller than blocking the same
+    layers into superstages.
+    """
+    num_devices = lax.psum(1, axis_name)
+    device_index = lax.axis_index(axis_name)
+    stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)  # (rounds, ...)
+
+    k_local = inp.shape[0]  # num_microbatches // num_devices
+    mb_shape = inp.shape[1:]
+    outputs = jnp.zeros((k_local,) + mb_shape, dtype=inp.dtype)
+    carry = jnp.zeros(mb_shape, dtype=inp.dtype)
+    ring = [(i, (i + 1) % num_devices) for i in range(num_devices)]
+    rotate_left = [(i, (i - 1) % num_devices) for i in range(num_devices)]
+    total_jobs = num_microbatches * rounds
+
+    def rotate(buf):
+        recv = lax.ppermute(buf[0], axis_name, rotate_left)
+        return jnp.concatenate([buf[1:], recv[None]], axis=0)
+
+    def tick(t, state):
+        outputs, carry, inp = state
+        job = jnp.clip(t - device_index, 0, total_jobs - 1)
+        active = jnp.logical_and(t >= device_index, t - device_index < total_jobs)
+        chunk = (job // num_devices) % rounds
+        params_c = jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, chunk, 0, keepdims=False), stage_params
+        )
+        consume_new = jnp.logical_and(jnp.logical_and(active, device_index == 0), chunk == 0)
+        h_in = jnp.where(consume_new, inp[0], carry)
+        h_out = stage_fn(params_c, h_in)
+
+        # buffer rotations are collectives selected by TICK-ONLY predicates, so
+        # every device adopts (or discards) a rotation on the same ticks and the
+        # ring contents stay globally consistent
+        consume_tick = jnp.logical_and(t < total_jobs, (t // num_devices) % rounds == 0)
+        inp = jnp.where(consume_tick, rotate(inp), inp)
+
+        out_job = t - (num_devices - 1)
+        write_tick = jnp.logical_and(
+            jnp.logical_and(out_job >= 0, out_job < total_jobs),
+            (out_job // num_devices) % rounds == rounds - 1,
+        )
+        rotated = rotate(outputs)
+        written = jnp.where(
+            device_index == num_devices - 1, rotated.at[k_local - 1].set(h_out), rotated
+        )
+        outputs = jnp.where(write_tick, written, outputs)
+
+        carry = lax.ppermute(h_out, axis_name, ring)
+        return outputs, carry, inp
+
+    total_ticks = total_jobs + num_devices - 1
+    outputs, _, _ = lax.fori_loop(0, total_ticks, tick, (outputs, carry, inp), unroll=False)
+    return outputs
+
+
+def pipeline_apply_circular(
+    stage_fn: Callable,
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    rounds: int,
+    axis: str = STAGE_AXIS,
+    remat: bool = False,
+) -> jax.Array:
+    """Circular (interleaved) pipeline: ``rounds`` stage-chunks per device.
+
+    The virtual pipeline has ``rounds * mesh.shape[axis]`` stages applied in
+    sequence; device d holds chunks d, d+D, d+2D, … stacked on a ``rounds``
+    axis, and a microbatch wraps around the device ring ``rounds`` times
+    (Megatron's interleaved schedule, praxis's circular pipeline). Compared to
+    blocking the same layers into :func:`superstage` groups, parameters per
+    device are identical but the fill/drain bubble shrinks by ``rounds``:
+    (D-1)/(M*rounds + D-1) vs (D-1)/(M + D-1).
+
+    :param stacked_params: pytree with leading axes ``(D, rounds, ...)`` —
+        chunk r of device d at ``[d, r]`` being virtual stage ``r*D + d``
+        (:func:`circular_superstage` builds this from flat stacked layers).
+    :param rounds: wraps around the device ring (1 = plain :func:`pipeline_apply`
+        schedule with ring handoff).
+    :returns: (batch, ...) output, microbatch-sharded over the stage axis.
+    """
+    num_devices = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            f"num_microbatches ({num_microbatches}) must evenly divide batch ({batch})"
+        )
+    if num_microbatches % num_devices:
+        raise ValueError(
+            f"the {axis!r} mesh axis size ({num_devices}) must evenly divide "
+            f"num_microbatches ({num_microbatches})"
+        )
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[:2] != (num_devices, rounds):
+            raise ValueError(
+                f"stacked_params leading axes {leaf.shape[:2]} must equal "
+                f"(devices, rounds) = ({num_devices}, {rounds})"
+            )
+
+    x_mb = x.reshape((num_microbatches, batch // num_microbatches) + x.shape[1:])
+    body_fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    params_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    body = functools.partial(
+        _circular_local,
+        stage_fn=body_fn,
+        axis_name=axis,
+        num_microbatches=num_microbatches,
+        rounds=rounds,
+    )
+    out_mb = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(params_spec, P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )(stacked_params, x_mb)
+    return out_mb.reshape((batch,) + x.shape[1:])
+
+
+def circular_superstage(
+    layer_fn: Callable, stacked_layer_params: Any, num_devices: int, rounds: int
+):
+    """Arrange L stacked layers for :func:`pipeline_apply_circular`.
+
+    Virtual stage v (= r*num_devices + d) owns layers ``[v*c, v*c + c)`` with
+    ``c = L / (num_devices * rounds)``; like :func:`superstage`, each chunk body
+    scans its layers sequentially. Returns ``(stage_fn, stage_params)`` with
+    ``stage_params`` leaves shaped ``(num_devices, rounds, c, ...)``.
+    """
+    leaves = jax.tree_util.tree_leaves(stacked_layer_params)
+    num_layers = leaves[0].shape[0]
+    virtual = num_devices * rounds
+    if num_layers % virtual:
+        raise ValueError(
+            f"num_layers ({num_layers}) must be divisible by devices*rounds ({virtual})"
+        )
+    per_chunk = num_layers // virtual
+
+    def arrange(p):
+        # layer order is (virtual stage, layer-in-chunk); virtual stage r*D + d
+        # must land at [d, r], so split the leading axis as (rounds, D) and swap
+        p = p.reshape((rounds, num_devices, per_chunk) + p.shape[1:])
+        return jnp.swapaxes(p, 0, 1)
+
+    stage_params = jax.tree_util.tree_map(arrange, stacked_layer_params)
+
+    def stage_fn(params, h):
+        def body(carry, layer_params):
+            return layer_fn(layer_params, carry), None
+
+        out, _ = lax.scan(body, h, params)
+        return out
+
+    return stage_fn, stage_params
+
+
 def stage_sharding(mesh: Mesh, axis: str = STAGE_AXIS) -> NamedSharding:
     """Sharding for stacked per-stage parameters (leading stage axis)."""
     return NamedSharding(mesh, P(axis))

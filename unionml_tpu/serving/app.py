@@ -226,7 +226,8 @@ def build_aiohttp_app(
         try:
             # validate EVERY prompt before scheduling any: a bad prompt in a
             # batch must not leave its siblings burning decode slots for a
-            # response that will never be delivered
+            # response that will never be delivered (TypeError covers
+            # non-numeric tokens / a non-list prompts value)
             for p in [prompt_ids] if prompt_ids is not None else prompts:
                 seq = np.asarray(p, dtype=np.int32).reshape(-1)
                 if seq.size == 0:
@@ -234,6 +235,9 @@ def build_aiohttp_app(
                 if seq.size >= gen.engine.max_len:
                     raise ValueError(f"prompt length {seq.size} >= max_len ({gen.engine.max_len})")
                 gen.engine.bucket_for(seq.size)
+        except (TypeError, ValueError) as exc:
+            return web.json_response({"detail": f"invalid prompt payload: {exc}"}, status=422)
+        try:
             if prompt_ids is not None:
                 tokens = await gen.generate(prompt_ids, max_new)
                 return web.json_response({"tokens": tokens})

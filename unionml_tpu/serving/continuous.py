@@ -109,6 +109,8 @@ class DecodeEngine:
         self._cache = init_cache(config, num_slots, max_len)
         self._lens = jnp.zeros((num_slots,), jnp.int32)
         self._last_logits = jnp.zeros((num_slots, config.vocab_size), jnp.float32)
+        self._seed = seed
+        self._resets = 0
         self._key = jax.random.PRNGKey(seed)
 
         # host mirrors (authoritative for scheduling; device arrays follow them)
@@ -223,6 +225,10 @@ class DecodeEngine:
         self._cache = init_cache(self._config, self.num_slots, self.max_len)
         self._lens = jnp.zeros((self.num_slots,), jnp.int32)
         self._last_logits = jnp.zeros((self.num_slots, self._config.vocab_size), jnp.float32)
+        # the key is also a step output, so it is poisoned too; a fresh
+        # reset-counted key keeps sampled streams from repeating the pre-crash run
+        self._resets += 1
+        self._key = jax.random.PRNGKey(self._seed + self._resets)
         self._active[:] = False
         self._lens_host[:] = 0
         self._remaining[:] = 0
