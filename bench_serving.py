@@ -188,27 +188,19 @@ def bench_bert(base: bool = False, seq_bucket: int = 128):
     return _measure(lambda: resident.predict(features=example), iters=100)
 
 
-def bench_http(iters: int = 200):
-    """End-to-end HTTP p50/p99 against the real aiohttp server: boots the server in
-    this process on a free port, drives single-row POST /predict requests, and tears
-    the runner/loop/thread down afterwards."""
+def _serve_app(app):
+    """Boot an aiohttp app on a background thread; returns ``(port, stop)``.
+
+    ``stop()`` tears the runner/loop/thread down. Bind/setup failures propagate
+    to the caller. Shared by every HTTP bench phase."""
     import asyncio
-    import json as _json
     import threading
-    import urllib.request
 
     from aiohttp import web
 
-    from unionml_tpu.model import ModelArtifact
-    from unionml_tpu.serving import build_aiohttp_app
     from unionml_tpu.utils import pick_free_port
 
-    model, feature_names = _build_mlp_model("http_bench_model")
-    model.artifact = ModelArtifact(model._init_model_object({}), None, None)
-
     port = pick_free_port()
-    app = build_aiohttp_app(model)
-
     loop = asyncio.new_event_loop()
     started = threading.Event()
     box = {}
@@ -242,23 +234,107 @@ def bench_http(iters: int = 200):
     if "error" in box:
         raise RuntimeError("HTTP bench server failed to start") from box["error"]
 
+    def stop():
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=30)
+
+    return port, stop
+
+
+def _post_json(port: int, path: str, payload: bytes, timeout: float = 30.0):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=payload,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as response:
+        response.read()
+
+
+def bench_http(iters: int = 200):
+    """End-to-end HTTP p50/p99 against the real aiohttp server: boots the server in
+    this process on a free port, drives single-row POST /predict requests, and tears
+    the runner/loop/thread down afterwards."""
+    import json as _json
+
+    from unionml_tpu.model import ModelArtifact
+    from unionml_tpu.serving import build_aiohttp_app
+
+    model, feature_names = _build_mlp_model("http_bench_model")
+    model.artifact = ModelArtifact(model._init_model_object({}), None, None)
+
+    port, stop = _serve_app(build_aiohttp_app(model))
     payload = _json.dumps(
         {"features": [dict(zip(feature_names, np.random.default_rng(1).normal(size=64)))]}
     ).encode()
+    try:
+        return _measure(lambda: _post_json(port, "/predict", payload), iters=iters)
+    finally:
+        stop()
+
+
+def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int = 8):
+    """Continuous-batching /generate over real HTTP: per-completion latency plus
+    aggregate decode throughput under concurrent load (the continuous-batching
+    payoff: N concurrent requests share every decode step)."""
+    import json as _json
+    import threading
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GPTConfig, GPTLMHeadModel
+    from unionml_tpu.models.gpt import init_params
+    from unionml_tpu.serving import build_aiohttp_app
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    if jax.default_backend() == "cpu":
+        config = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+    else:  # GPT-2 small on a real accelerator
+        config = GPTConfig(dropout=0.0, dtype=jnp.bfloat16)
+    model = GPTLMHeadModel(config)
+    variables = init_params(config, seq_len=16)
+    stub = types.SimpleNamespace(name="generate_bench_model", artifact=object())
+
+    port, stop = _serve_app(
+        build_aiohttp_app(
+            stub, resident=False, coalesce=False,
+            generator=lambda: DecodeEngine(
+                model, variables, num_slots=concurrency, max_len=128, prefill_buckets=(8, 16)
+            ),
+        )
+    )
+    payload = _json.dumps({"prompt_ids": [3, 1, 4, 1, 5], "max_new_tokens": max_new_tokens}).encode()
 
     def request():
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{port}/predict", data=payload,
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=30) as response:
-            response.read()
+        _post_json(port, "/generate", payload, timeout=120)
 
     try:
-        return _measure(request, iters=iters)
+        stats = _measure(request, iters=iters)
+        stats["max_new_tokens"] = max_new_tokens
+        stats["tokens_per_s_single"] = round(max_new_tokens / (stats["p50_ms"] / 1e3), 1)
+
+        # concurrent phase: `concurrency` client threads sharing the engine's slots
+        request()  # ensure every bucket is warm before the timed burst
+        n_each = max(1, iters // concurrency)
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=lambda: [request() for _ in range(n_each)])
+            for _ in range(concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        total_tokens = concurrency * n_each * max_new_tokens
+        stats["concurrency"] = concurrency
+        stats["tokens_per_s_concurrent"] = round(total_tokens / elapsed, 1)
+        return stats
     finally:
-        loop.call_soon_threadsafe(loop.stop)
-        thread.join(timeout=30)
+        stop()
 
 
 def main():
@@ -292,6 +368,13 @@ def main():
     results["models"]["digits_mlp_64f_http"] = http
     print(json.dumps({"metric": "http_predict_p50_ms", "value": http["p50_ms"], "unit": "ms",
                       "model": "digits_mlp_64f_http", "p99_ms": http["p99_ms"], "backend": backend}))
+
+    gen = bench_generate()
+    gen_name = "gpt_tiny_generate_http" if backend == "cpu" else "gpt2_small_generate_http"
+    results["models"][gen_name] = gen
+    print(json.dumps({"metric": "http_generate_p50_ms", "value": gen["p50_ms"], "unit": "ms",
+                      "model": gen_name, "tokens_per_s_concurrent": gen["tokens_per_s_concurrent"],
+                      "backend": backend}))
 
     with open(args.out, "w") as fh:
         json.dump(results, fh, indent=2)

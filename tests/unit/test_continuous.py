@@ -257,6 +257,100 @@ def test_generate_route_over_http(gpt):
     assert batch == expected_batch
 
 
+def test_batcher_stream_yields_tokens_incrementally(gpt):
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(4, 8))
+    batcher = ContinuousBatcher(engine)
+    expected = solo(model, variables, [3, 1, 4], 5)
+
+    async def main():
+        seen = []
+        # a completed-list request runs CONCURRENTLY with the stream on the
+        # shared engine
+        whole_task = asyncio.ensure_future(batcher.generate([2, 7], 4))
+        async for token in batcher.stream([3, 1, 4], 5):
+            seen.append(token)
+        return seen, await whole_task
+
+    try:
+        streamed, whole = asyncio.run(main())
+    finally:
+        batcher.close()
+    assert streamed == expected
+    assert whole == solo(model, variables, [2, 7], 4)
+
+
+def test_stream_route_ndjson(gpt):
+    import types
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from unionml_tpu.serving import build_aiohttp_app
+
+    model, variables = gpt
+    stub = types.SimpleNamespace(name="gen-app", artifact=object())
+    app = build_aiohttp_app(
+        stub,
+        resident=False,
+        coalesce=False,
+        generator=lambda: DecodeEngine(
+            model, variables, num_slots=2, max_len=64, prefill_buckets=(4, 8)
+        ),
+    )
+    expected = solo(model, variables, [3, 1, 4], 5)
+
+    async def main():
+        import json as _json
+
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/generate", json={"prompt_ids": [3, 1, 4], "max_new_tokens": 5, "stream": True}
+            )
+            assert resp.status == 200
+            assert resp.content_type == "application/x-ndjson"
+            lines = [_json.loads(l) for l in (await resp.text()).strip().splitlines()]
+
+            resp = await client.post(
+                "/generate", json={"prompts": [[1, 2]], "max_new_tokens": 2, "stream": True}
+            )
+            assert resp.status == 422  # streaming is single-prompt only
+            return lines
+        finally:
+            await client.close()
+
+    lines = asyncio.run(main())
+    assert [l["token"] for l in lines[:-1]] == expected
+    assert lines[-1] == {"done": True, "tokens": expected}
+
+
+def test_abandoned_stream_frees_slot_and_worker_survives(gpt):
+    """Closing a stream early (client disconnect) must cancel its decode slot;
+    other in-flight requests keep decoding correctly on the surviving worker."""
+    import time as _time
+
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(4, 8))
+    batcher = ContinuousBatcher(engine)
+    expected = solo(model, variables, [2, 7], 4)
+
+    async def main():
+        stream_it = batcher.stream([3, 1, 4], 60)  # long budget on the ONLY slot
+        first = [await anext(stream_it), await anext(stream_it)]
+        await stream_it.aclose()  # abandon mid-decode
+        # the slot must come free for the next request (worker still alive)
+        return first, await batcher.generate([2, 7], 4)
+
+    try:
+        first, second = asyncio.run(main())
+    finally:
+        batcher.close()
+    assert first == solo(model, variables, [3, 1, 4], 60)[:2]
+    assert second == expected
+    assert engine.num_active == 0
+
+
 def test_batcher_concurrent_requests_match_solo(gpt):
     model, variables = gpt
     engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(4, 8))

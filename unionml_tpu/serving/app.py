@@ -222,6 +222,10 @@ def build_aiohttp_app(
             max_new = int(payload.get("max_new_tokens", 32))
         except (TypeError, ValueError):
             return web.json_response({"detail": "max_new_tokens must be an integer."}, status=422)
+        if max_new < 1:
+            # pre-validated here so the streaming path can 422 BEFORE committing
+            # a 200 status line (the engine's own check would be too late)
+            return web.json_response({"detail": "max_new_tokens must be >= 1."}, status=422)
 
         try:
             # validate EVERY prompt before scheduling any: a bad prompt in a
@@ -237,6 +241,46 @@ def build_aiohttp_app(
                 gen.engine.bucket_for(seq.size)
         except (TypeError, ValueError) as exc:
             return web.json_response({"detail": f"invalid prompt payload: {exc}"}, status=422)
+        stream = bool(payload.get("stream"))
+        if stream and prompt_ids is None:
+            return web.json_response(
+                {"detail": "stream=true requires a single prompt_ids prompt."}, status=422
+            )
+        if stream:
+            import json as _json
+
+            # ndjson chunks: one {"token": N} line per decoded token, then a
+            # {"done": true, "tokens": [...]} trailer. Prompt validation already
+            # passed above; failures after prepare() can only be reported
+            # in-band as an {"error": ...} line (the status line is already out)
+            response = web.StreamResponse()
+            response.content_type = "application/x-ndjson"
+            await response.prepare(request)
+            tokens = []
+            import contextlib
+
+            try:
+                # aclosing guarantees the stream iterator closes promptly on an
+                # early exit (client disconnect -> write raises), which cancels
+                # the request's decode slot
+                async with contextlib.aclosing(gen.stream(prompt_ids, max_new)) as stream_it:
+                    async for token in stream_it:
+                        tokens.append(token)
+                        await response.write((_json.dumps({"token": token}) + "\n").encode())
+                await response.write(
+                    (_json.dumps({"done": True, "tokens": tokens}) + "\n").encode()
+                )
+            except Exception as exc:
+                logger.warning("Streaming generation ended early: %s", exc)
+                try:  # the transport may be the thing that failed
+                    await response.write((_json.dumps({"error": str(exc)}) + "\n").encode())
+                except Exception:
+                    pass
+            try:
+                await response.write_eof()
+            except Exception:
+                pass
+            return response
         try:
             if prompt_ids is not None:
                 tokens = await gen.generate(prompt_ids, max_new)

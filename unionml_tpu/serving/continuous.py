@@ -271,6 +271,10 @@ class DecodeEngine:
         """Deactivate every slot (in-flight state is abandoned; cache reuse is safe)."""
         self._active[:] = False
 
+    def cancel(self, slot: int) -> None:
+        """Deactivate one slot (its request is abandoned; the slot is reusable)."""
+        self._active[slot] = False
+
     def generate(self, prompt_ids: Sequence[int], max_new_tokens: int) -> List[int]:
         """Single-request convenience driver (tests/scripts): run one request to
         completion on an otherwise-idle engine and return its emitted tokens."""
@@ -283,21 +287,68 @@ class DecodeEngine:
         return out
 
 
+class _FutureSink:
+    """Buffers emitted tokens; resolves an asyncio future with the full list."""
+
+    #: set by the consumer when it abandons the request (disconnect/early exit);
+    #: the worker cancels the slot instead of delivering to a dead consumer
+    cancelled = False
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, future: asyncio.Future) -> None:
+        self._loop = loop
+        self._future = future
+        self._tokens: List[int] = []
+
+    def emit(self, token: int) -> None:
+        self._tokens.append(token)
+
+    def finish(self) -> None:
+        tokens = list(self._tokens)
+        self._loop.call_soon_threadsafe(
+            lambda: self._future.done() or self._future.set_result(tokens)
+        )
+
+    def fail(self, exc: BaseException) -> None:
+        self._loop.call_soon_threadsafe(
+            lambda: self._future.done() or self._future.set_exception(exc)
+        )
+
+
+_STREAM_DONE = object()
+
+
+class _QueueSink:
+    """Forwards each token to an asyncio queue as it decodes (streaming)."""
+
+    cancelled = False
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, queue: "asyncio.Queue") -> None:
+        self._loop = loop
+        self._queue = queue
+
+    def emit(self, token: int) -> None:
+        self._loop.call_soon_threadsafe(self._queue.put_nowait, token)
+
+    def finish(self) -> None:
+        self._loop.call_soon_threadsafe(self._queue.put_nowait, _STREAM_DONE)
+
+    def fail(self, exc: BaseException) -> None:
+        self._loop.call_soon_threadsafe(self._queue.put_nowait, exc)
+
+
 class ContinuousBatcher:
     """Asyncio facade running a :class:`DecodeEngine` on a worker thread.
 
     ``await generate(prompt_ids, max_new_tokens)`` enqueues a request; the worker
     admits queued requests into free slots between decode steps and resolves each
-    future with the completed token list. One engine step at a time, no step
-    blocking the event loop.
+    future with the completed token list. ``stream(...)`` yields tokens as they
+    decode instead. One engine step at a time, no step blocking the event loop.
     """
 
     def __init__(self, engine: DecodeEngine) -> None:
         self._engine = engine
-        self._pending: "collections.deque[Tuple[np.ndarray, int, asyncio.Future, asyncio.AbstractEventLoop]]" = (
-            collections.deque()
-        )
-        self._results: Dict[int, Tuple[List[int], asyncio.Future, asyncio.AbstractEventLoop]] = {}
+        self._pending: "collections.deque[Tuple[np.ndarray, int, Any]]" = collections.deque()
+        self._sinks: Dict[int, Any] = {}
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._closed = False
@@ -312,9 +363,7 @@ class ContinuousBatcher:
             self._worker = threading.Thread(target=self._run, name="continuous-batcher", daemon=True)
             self._worker.start()
 
-    async def generate(self, prompt_ids: Sequence[int], max_new_tokens: int) -> List[int]:
-        loop = asyncio.get_running_loop()
-        future: asyncio.Future = loop.create_future()
+    def _submit(self, prompt_ids: Sequence[int], max_new_tokens: int, sink: Any) -> None:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         # surface bad requests on the caller's side, not the worker's
         if prompt.size == 0:
@@ -323,28 +372,73 @@ class ContinuousBatcher:
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._pending.append((prompt, int(max_new_tokens), future, loop))
+            self._pending.append((prompt, int(max_new_tokens), sink))
         self._ensure_worker()
         self._work.set()
+
+    async def generate(self, prompt_ids: Sequence[int], max_new_tokens: int) -> List[int]:
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        self._submit(prompt_ids, max_new_tokens, _FutureSink(loop, future))
         return await future
+
+    async def stream(self, prompt_ids: Sequence[int], max_new_tokens: int):
+        """Async iterator of tokens, yielded as the engine decodes them.
+
+        The request shares slots (and decode steps) with every other in-flight
+        request; per-token latency is one engine step. Abandoning the iterator
+        early (client disconnect) cancels the request's decode slot.
+        """
+        loop = asyncio.get_running_loop()
+        queue: "asyncio.Queue" = asyncio.Queue()
+        sink = _QueueSink(loop, queue)
+        self._submit(prompt_ids, max_new_tokens, sink)
+        try:
+            while True:
+                item = await queue.get()
+                if item is _STREAM_DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # reached on normal completion too (cancelling a finished request
+            # is a no-op); on early exit it frees the slot for other requests
+            sink.cancelled = True
+
+    def _deliver(self, sink: Any, method: str, *args) -> bool:
+        """Invoke a sink callback, absorbing consumer-side failures.
+
+        A dead consumer (its event loop closed after a disconnect/early exit)
+        raises from ``call_soon_threadsafe``; that must cost only this request —
+        never the worker thread, which every other in-flight request depends on.
+        """
+        try:
+            getattr(sink, method)(*args)
+            return True
+        except Exception:
+            logger.warning("sink %s delivery failed (consumer gone?); dropping request", method)
+            return False
 
     def _admit(self) -> None:
         while True:
             with self._lock:
                 if not self._pending or not self._engine.free_slots:
                     return
-                prompt, budget, future, loop = self._pending.popleft()
+                prompt, budget, sink = self._pending.popleft()
+            if sink.cancelled:  # consumer gave up while queued
+                continue
             try:
                 slot = self._engine.add_request(prompt, budget)
             except Exception as exc:  # reject this request, keep serving others
-                loop.call_soon_threadsafe(future.set_exception, exc)
+                self._deliver(sink, "fail", exc)
                 continue
-            self._results[slot] = ([], future, loop)
+            self._sinks[slot] = sink
 
     def _run(self) -> None:
         while True:
             with self._lock:
-                if self._closed and not self._pending and not self._results:
+                if self._closed and not self._pending and not self._sinks:
                     return
             self._admit()
             if self._engine.num_active == 0:
@@ -359,25 +453,29 @@ class ContinuousBatcher:
                 events = self._engine.step()
             except Exception as exc:  # fail every in-flight request loudly
                 logger.exception("continuous-batching step failed")
-                for slot, (_, future, loop) in list(self._results.items()):
-                    loop.call_soon_threadsafe(
-                        lambda f=future, e=exc: f.done() or f.set_exception(RuntimeError(str(e)))
-                    )
-                self._results.clear()
+                for sink in self._sinks.values():
+                    self._deliver(sink, "fail", RuntimeError(str(exc)))
+                self._sinks.clear()
                 self._engine.abort_all()
                 continue
             for event in events:
-                entry = self._results.get(event.slot)
-                if entry is None:
+                sink = self._sinks.get(event.slot)
+                if sink is None:
                     continue
-                tokens, future, loop = entry
+                if sink.cancelled:  # consumer abandoned the stream mid-decode
+                    del self._sinks[event.slot]
+                    self._engine.cancel(event.slot)
+                    continue
+                ok = True
                 if event.emit:
-                    tokens.append(event.token)
+                    ok = self._deliver(sink, "emit", event.token)
+                if not ok:
+                    del self._sinks[event.slot]
+                    self._engine.cancel(event.slot)
+                    continue
                 if event.finished:
-                    del self._results[event.slot]
-                    loop.call_soon_threadsafe(
-                        lambda f=future, t=list(tokens): f.done() or f.set_result(t)
-                    )
+                    del self._sinks[event.slot]
+                    self._deliver(sink, "finish")
 
     def close(self) -> None:
         with self._lock:
