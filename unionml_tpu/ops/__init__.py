@@ -1,4 +1,5 @@
-"""Hot ops owned by the framework: attention kernels and fused losses."""
+"""Hot ops owned by the framework: attention kernels, fused losses, and
+weight-only int8 quantization."""
 
 from unionml_tpu.ops.attention import attention, flash_attention, xla_attention
 from unionml_tpu.ops.losses import (
@@ -6,12 +7,24 @@ from unionml_tpu.ops.losses import (
     cross_entropy_and_accuracy,
     cross_entropy_with_integer_labels,
 )
+from unionml_tpu.ops.quant import (
+    QuantizedArray,
+    dequantize_tree,
+    quantize_array,
+    quantize_tree,
+    quantized_bytes,
+)
 
 __all__ = [
+    "QuantizedArray",
     "accuracy",
     "attention",
     "cross_entropy_and_accuracy",
     "cross_entropy_with_integer_labels",
+    "dequantize_tree",
     "flash_attention",
+    "quantize_array",
+    "quantize_tree",
+    "quantized_bytes",
     "xla_attention",
 ]

@@ -72,6 +72,11 @@ class DecodeEngine:
         sampled streams depend on engine scheduling order, unlike ``generate``.
     :param prefill_buckets: allowed padded prompt lengths; prompts longer than the
         largest bucket (or ``max_len``) are rejected with ``ValueError``.
+    :param quantize: ``"int8"`` stores matmul kernels as per-channel int8
+        (:mod:`unionml_tpu.ops.quant`) — single-token decode is HBM-bandwidth
+        bound, so int8 weights halve the per-step weight traffic vs bf16;
+        dequantization happens inside the compiled step and fuses into the
+        matmuls. ``None`` (default) serves full-precision weights.
     """
 
     def __init__(
@@ -85,6 +90,7 @@ class DecodeEngine:
         temperature: float = 0.0,
         prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
         seed: int = 0,
+        quantize: Optional[str] = None,
     ) -> None:
         from unionml_tpu.models.gpt import init_cache
 
@@ -95,6 +101,16 @@ class DecodeEngine:
                 f"max_len ({max_len}) exceeds max_position_embeddings "
                 f"({config.max_position_embeddings})"
             )
+        if quantize not in (None, "int8"):
+            raise ValueError(f"Unknown quantize mode {quantize!r}; expected None or 'int8'")
+        if quantize == "int8":
+            from unionml_tpu.ops.quant import dequantize_tree, quantize_tree
+
+            variables = quantize_tree(variables)
+            maybe_dequant = dequantize_tree
+        else:
+            maybe_dequant = lambda tree: tree
+
         self._model = model
         self._variables = variables
         self._config = config
@@ -121,6 +137,7 @@ class DecodeEngine:
         temperature_ = self.temperature
 
         def _step(variables, cache, last_logits, lens, active, key):
+            variables = maybe_dequant(variables)
             key, subkey = jax.random.split(key)
             if temperature_ <= 0.0:
                 tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
@@ -138,6 +155,7 @@ class DecodeEngine:
         self._step_fn = jax.jit(_step, donate_argnums=(1, 2))
 
         def _prefill(variables, prompt_ids, length):
+            variables = maybe_dequant(variables)
             local_cache = init_cache(config, 1, prompt_ids.shape[1])
             logits, local_cache = model.apply(variables, prompt_ids, cache=local_cache, position=0)
             # right padding + causal attention: the logits at the last REAL token
