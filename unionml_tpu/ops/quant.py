@@ -37,7 +37,7 @@ __all__ = [
 
 
 @jax.tree_util.register_pytree_node_class
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # array fields make generated __eq__ raise on bool()
 class QuantizedArray:
     """int8 values + per-channel f32 scales standing in for a float array."""
 

@@ -16,8 +16,11 @@ from typing import Dict, Tuple
 #: measured winners — populated from bench_kernels.py runs on real TPU hardware.
 #: Format: {(seq_q, seq_k, head_dim): (block_q, block_k)}
 TUNED_BLOCKS: Dict[Tuple[int, int, int], Tuple[int, int]] = {
-    # no real-TPU measurements yet (round-2: remote-TPU tunnel down all round;
-    # see TPU_PROBES.log) — bench_kernels.py fills this table when hardware exists
+    # Measured on v5e (axon tunnel window 2026-07-29T13:53Z, KERNEL_BENCH.json):
+    # seq 128: only (128,128) tiles; fwd+bwd 12.35ms vs XLA 12.72ms -> pallas.
+    # seq 512: (256,128) wins fwd+bwd 11.48ms vs XLA 14.63ms (fwd 4.43 vs 11.10).
+    (128, 128, 64): (128, 128),
+    (512, 512, 64): (256, 128),
 }
 
 #: candidate block edges for the sweep and the fallback ladder
