@@ -274,7 +274,7 @@ def bench_http(iters: int = 200):
         stop()
 
 
-def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int = 8):
+def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int = 8, lookahead: int = 8):
     """Continuous-batching /generate over real HTTP: per-completion latency plus
     aggregate decode throughput under concurrent load (the continuous-batching
     payoff: N concurrent requests share every decode step)."""
@@ -304,6 +304,9 @@ def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int =
             generator=lambda: DecodeEngine(
                 model, variables, num_slots=concurrency, max_len=128, prefill_buckets=(8, 16)
             ),
+            # fuse decode steps per device dispatch: cuts per-token host syncs
+            # (the dominant cost on remote devices; measurable device-local too)
+            generate_lookahead=lookahead,
         )
     )
     payload = _json.dumps({"prompt_ids": [3, 1, 4, 1, 5], "max_new_tokens": max_new_tokens}).encode()
@@ -331,6 +334,7 @@ def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int =
         elapsed = time.perf_counter() - t0
         total_tokens = concurrency * n_each * max_new_tokens
         stats["concurrency"] = concurrency
+        stats["lookahead"] = lookahead
         stats["tokens_per_s_concurrent"] = round(total_tokens / elapsed, 1)
         return stats
     finally:

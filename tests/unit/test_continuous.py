@@ -366,3 +366,89 @@ def test_batcher_concurrent_requests_match_solo(gpt):
     finally:
         batcher.close()
     assert results == expected
+
+
+# ------------------------------------------------------------------- lookahead
+
+
+def test_lookahead_matches_sequential_greedy(gpt):
+    """A fused K-step burst emits exactly what K sequential steps would."""
+    model, variables = gpt
+    requests = [([3, 1, 4, 1, 5], 9), ([2, 7], 6), ([1, 8, 2, 8], 4)]
+
+    def run(lookahead):
+        engine = DecodeEngine(model, variables, num_slots=3, max_len=64, prefill_buckets=(8,))
+        slots = {engine.add_request(p, n): i for i, (p, n) in enumerate(requests)}
+        out = {i: [] for i in range(3)}
+        while engine.num_active:
+            for ev in engine.step(lookahead):
+                if ev.emit:
+                    out[slots[ev.slot]].append(ev.token)
+        return out, engine._active.copy(), engine._lens_host.copy()
+
+    seq_out, seq_active, seq_lens = run(1)
+    for k in (3, 8, 64):
+        burst_out, burst_active, burst_lens = run(k)
+        assert burst_out == seq_out, f"lookahead={k}"
+        np.testing.assert_array_equal(burst_active, seq_active)
+        np.testing.assert_array_equal(burst_lens, seq_lens)
+
+
+def test_lookahead_matches_sequential_sampled(gpt):
+    """Key chaining inside the scan reproduces the sequential sample stream."""
+    model, variables = gpt
+    prompt = [3, 1, 4, 1, 5]
+    a = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,),
+                     temperature=0.8, seed=7)
+    b = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,),
+                     temperature=0.8, seed=7)
+    assert a.generate(prompt, 10) == b.generate(prompt, 10, lookahead=4)
+
+
+def test_lookahead_eos_retires_midburst(gpt):
+    """A slot hitting eos inside a burst stops emitting and frees, exactly."""
+    model, variables = gpt
+    prompt = [3, 1, 4, 1, 5]
+    expected = solo(model, variables, prompt, 6)
+    eos = expected[2]
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,),
+                          eos_token_id=eos)
+    assert engine.generate(prompt, 6, lookahead=6) == expected[: expected.index(eos)]
+    assert engine.num_active == 0
+
+
+def test_lookahead_capacity_force_finish(gpt):
+    """Cache-room clamp inside the scan force-finishes like the host rule."""
+    model, variables = gpt
+    prompt = [1, 2, 3, 4]
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=16, prefill_buckets=(4, 8))
+    out = engine.generate(prompt, 100, lookahead=32)
+    budget = 16 - 1 - len(prompt)
+    assert len(out) == budget
+    assert out == solo(model, variables, prompt, budget)
+
+
+def test_lookahead_int8_quantized_engine(gpt):
+    """Lookahead composes with int8 weight-only quantization."""
+    model, variables = gpt
+    prompt = [3, 1, 4, 1, 5]
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(8,),
+                          quantize="int8")
+    assert engine.generate(prompt, 8, lookahead=4) == engine.generate(prompt, 8, lookahead=1)
+
+
+def test_batcher_lookahead_matches_solo(gpt):
+    """End-to-end: a lookahead batcher resolves the same tokens as generate."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(8,))
+    batcher = ContinuousBatcher(engine, lookahead=4)
+    prompts = [([3, 1, 4, 1, 5], 7), ([2, 7], 5)]
+
+    async def go():
+        return await asyncio.gather(
+            *(batcher.generate(p, n) for p, n in prompts)
+        )
+
+    results = asyncio.new_event_loop().run_until_complete(go())
+    batcher.close()
+    assert results == [solo(model, variables, p, n) for p, n in prompts]

@@ -88,6 +88,7 @@ def build_aiohttp_app(
     seq_buckets: Optional[Any] = None,
     example_features: Optional[Any] = None,
     generator: Optional[Any] = None,
+    generate_lookahead: int = 1,
 ):
     """Create the aiohttp application with a resident predictor.
 
@@ -104,6 +105,8 @@ def build_aiohttp_app(
     :class:`~unionml_tpu.serving.continuous.ContinuousBatcher`, or a zero-arg
     callable returning either — the callable form is evaluated at startup, AFTER
     the model artifact loads, so the engine can be built from trained variables.
+    ``generate_lookahead`` sets the decode steps fused per device dispatch when
+    the app wraps a bare engine (see :meth:`DecodeEngine.step`).
     """
     from aiohttp import web
 
@@ -139,7 +142,7 @@ def build_aiohttp_app(
                 generator, (DecodeEngine, ContinuousBatcher)
             ) else generator
             if isinstance(built, DecodeEngine):
-                built = ContinuousBatcher(built)
+                built = ContinuousBatcher(built, lookahead=generate_lookahead)
             app["continuous_batcher"] = built
         logger.info("Serving app ready (model=%s).", model.name)
 

@@ -136,7 +136,10 @@ class DecodeEngine:
 
         temperature_ = self.temperature
 
-        def _step(variables, cache, last_logits, lens, active, key):
+        def _decode_body(variables, cache, last_logits, lens, active, key):
+            """One decode step — the single shared body for ``_step_fn`` AND the
+            lookahead scan, so sampling/freeze rules cannot drift between them."""
+            # dequant here (not hoisted) so weight reads stay int8 in HBM
             variables = maybe_dequant(variables)
             key, subkey = jax.random.split(key)
             if temperature_ <= 0.0:
@@ -152,7 +155,7 @@ class DecodeEngine:
             new_logits = jnp.where(active[:, None], logits[:, -1, :], last_logits)
             return cache, new_logits, new_lens, tokens, key
 
-        self._step_fn = jax.jit(_step, donate_argnums=(1, 2))
+        self._step_fn = jax.jit(_decode_body, donate_argnums=(1, 2))
 
         def _prefill(variables, prompt_ids, length):
             variables = maybe_dequant(variables)
@@ -176,6 +179,43 @@ class DecodeEngine:
             )
 
         self._insert_fn = jax.jit(_insert, donate_argnums=(0, 1, 2))
+
+        def _make_multi_step(n_steps: int):
+            """K decode steps fused into one device program (``lax.scan``).
+
+            One host↔device round-trip per K tokens instead of per token: the
+            per-step token fetch is pure overhead (measured ~70ms over a remote
+            device tunnel, TPU_PROBES.log 2026-07-29; host sync + launch cost
+            device-local too). Slot retirement runs inside the scan with the same
+            rules the host applies (eos / budget / cache room), so a fused burst
+            emits exactly what K sequential :meth:`step` calls would; the host
+            replays the fetched token matrix to update its mirrors identically.
+            """
+
+            def _multi(variables, cache, last_logits, lens, active, remaining, key):
+                def body(carry, _):
+                    cache, last_logits, lens, active, remaining, key = carry
+                    cache, new_logits, new_lens, tokens, key = _decode_body(
+                        variables, cache, last_logits, lens, active, key
+                    )
+                    new_remaining = jnp.where(active, remaining - 1, remaining)
+                    finished = (new_remaining <= 0) | (new_lens >= max_len - 1)
+                    if eos_token_id is not None:
+                        finished = finished | (tokens == eos_token_id)
+                    new_active = active & ~finished
+                    carry = (cache, new_logits, new_lens, new_active, new_remaining, key)
+                    return carry, (tokens, active)
+
+                carry = (cache, last_logits, lens, active, remaining, key)
+                (cache, last_logits, lens, active, remaining, key), (toks, masks) = jax.lax.scan(
+                    body, carry, None, length=n_steps
+                )
+                return cache, last_logits, lens, key, toks, masks
+
+            return jax.jit(_multi, donate_argnums=(1, 2))
+
+        self._make_multi_step = _make_multi_step
+        self._scan_fns: Dict[int, Any] = {}
 
     # ------------------------------------------------------------------ scheduling
 
@@ -251,38 +291,94 @@ class DecodeEngine:
         self._lens_host[:] = 0
         self._remaining[:] = 0
 
-    def step(self) -> List[StepEvent]:
-        """Decode one token for every active slot; returns per-slot events.
+    def _apply_token(self, slot: int, token: int) -> StepEvent:
+        """Advance the host mirrors for one decoded token (same rules as on device)."""
+        self._remaining[slot] -= 1
+        self._lens_host[slot] = min(self._lens_host[slot] + 1, self.max_len - 1)
+        is_eos = self.eos_token_id is not None and token == self.eos_token_id
+        finished = (
+            is_eos
+            or self._remaining[slot] <= 0
+            or self._lens_host[slot] >= self.max_len - 1
+        )
+        if finished:
+            self._active[slot] = False
+        return StepEvent(slot=slot, token=token, emit=not is_eos, finished=finished)
+
+    def step(self, lookahead: int = 1) -> List[StepEvent]:
+        """Decode for every active slot; returns per-slot events.
+
+        :param lookahead: number of decode steps fused into ONE device program and
+            ONE host sync (``lax.scan``). The burst emits exactly what ``lookahead``
+            sequential calls would — slot retirement (eos / budget / cache room)
+            runs inside the scan — at 1/lookahead the host-sync overhead. The
+            trade-off is token delivery latency: streamed tokens arrive in bursts.
+            Clamped to the largest useful depth for the current slots; compiled
+            once per distinct depth.
 
         A device failure mid-step resets the engine (see :meth:`reset`) and
         re-raises; every in-flight request is lost but the engine stays usable.
         """
         if not self._active.any():
             return []
-        active_dev = jnp.asarray(self._active)
-        try:
-            self._cache, self._last_logits, self._lens, tokens, self._key = self._step_fn(
-                self._variables, self._cache, self._last_logits, self._lens, active_dev, self._key
+        lookahead = max(1, int(lookahead))
+        if lookahead > 1:
+            # no point scanning past the moment the last slot can retire — but a
+            # clamp to the EXACT depth would compile a distinct scan program per
+            # tail length, so round up to the next power of two: a bounded ladder
+            # of programs (log2 K of them), at most `needed` wasted masked steps
+            room = np.minimum(
+                self._remaining[self._active],
+                (self.max_len - 1) - self._lens_host[self._active],
             )
-            tokens_host = np.asarray(jax.device_get(tokens))  # hard sync (see utils.hard_sync)
+            needed = max(1, int(room.max()))
+            if needed < lookahead:
+                lookahead = min(lookahead, 1 << (needed - 1).bit_length())
+        if lookahead == 1:
+            active_dev = jnp.asarray(self._active)
+            try:
+                self._cache, self._last_logits, self._lens, tokens, self._key = self._step_fn(
+                    self._variables, self._cache, self._last_logits, self._lens, active_dev, self._key
+                )
+                tokens_host = np.asarray(jax.device_get(tokens))  # hard sync (see utils.hard_sync)
+            except Exception:
+                self.reset()
+                raise
+            return [
+                self._apply_token(int(slot), int(tokens_host[int(slot)]))
+                for slot in np.flatnonzero(self._active)
+            ]
+
+        fn = self._scan_fns.get(lookahead)
+        if fn is None:
+            fn = self._scan_fns[lookahead] = self._make_multi_step(lookahead)
+        active_dev = jnp.asarray(self._active)
+        remaining_dev = jnp.asarray(
+            np.minimum(self._remaining, np.iinfo(np.int32).max), dtype=jnp.int32
+        )
+        try:
+            (
+                self._cache,
+                self._last_logits,
+                self._lens,
+                self._key,
+                tokens,
+                masks,
+            ) = fn(
+                self._variables, self._cache, self._last_logits, self._lens,
+                active_dev, remaining_dev, self._key,
+            )
+            tokens_host = np.asarray(jax.device_get(tokens))
+            masks_host = np.asarray(jax.device_get(masks))
         except Exception:
             self.reset()
             raise
         events: List[StepEvent] = []
-        for slot in np.flatnonzero(self._active):
-            slot = int(slot)
-            token = int(tokens_host[slot])
-            self._remaining[slot] -= 1
-            self._lens_host[slot] = min(self._lens_host[slot] + 1, self.max_len - 1)
-            is_eos = self.eos_token_id is not None and token == self.eos_token_id
-            finished = (
-                is_eos
-                or self._remaining[slot] <= 0
-                or self._lens_host[slot] >= self.max_len - 1
+        for i in range(tokens_host.shape[0]):
+            events.extend(
+                self._apply_token(int(slot), int(tokens_host[i, int(slot)]))
+                for slot in np.flatnonzero(masks_host[i])
             )
-            if finished:
-                self._active[slot] = False
-            events.append(StepEvent(slot=slot, token=token, emit=not is_eos, finished=finished))
         return events
 
     def abort_all(self) -> None:
@@ -293,13 +389,15 @@ class DecodeEngine:
         """Deactivate one slot (its request is abandoned; the slot is reusable)."""
         self._active[slot] = False
 
-    def generate(self, prompt_ids: Sequence[int], max_new_tokens: int) -> List[int]:
+    def generate(
+        self, prompt_ids: Sequence[int], max_new_tokens: int, *, lookahead: int = 1
+    ) -> List[int]:
         """Single-request convenience driver (tests/scripts): run one request to
         completion on an otherwise-idle engine and return its emitted tokens."""
         slot = self.add_request(prompt_ids, max_new_tokens)
         out: List[int] = []
         while self._active[slot]:
-            for event in self.step():
+            for event in self.step(lookahead):
                 if event.slot == slot and event.emit:
                     out.append(event.token)
         return out
@@ -361,10 +459,17 @@ class ContinuousBatcher:
     admits queued requests into free slots between decode steps and resolves each
     future with the completed token list. ``stream(...)`` yields tokens as they
     decode instead. One engine step at a time, no step blocking the event loop.
+
+    :param lookahead: decode steps fused per device dispatch (see
+        :meth:`DecodeEngine.step`). Raises throughput by cutting host syncs;
+        streamed tokens arrive in bursts of up to this size, and queued requests
+        wait up to a burst before admission — keep it small (4-16) for
+        interactive serving.
     """
 
-    def __init__(self, engine: DecodeEngine) -> None:
+    def __init__(self, engine: DecodeEngine, *, lookahead: int = 1) -> None:
         self._engine = engine
+        self._lookahead = max(1, int(lookahead))
         self._pending: "collections.deque[Tuple[np.ndarray, int, Any]]" = collections.deque()
         self._sinks: Dict[int, Any] = {}
         self._lock = threading.Lock()
@@ -468,7 +573,14 @@ class ContinuousBatcher:
                 self._work.wait(timeout=0.5)
                 continue
             try:
-                events = self._engine.step()
+                # full house + queued work: shorten bursts so a retiring slot is
+                # readmitted within a few steps — but not to 1, which would forfeit
+                # the whole lookahead win for the entire duration of an overload
+                with self._lock:
+                    contended = bool(self._pending) and not self._engine.free_slots
+                events = self._engine.step(
+                    min(self._lookahead, 4) if contended else self._lookahead
+                )
             except Exception as exc:  # fail every in-flight request loudly
                 logger.exception("continuous-batching step failed")
                 for sink in self._sinks.values():
