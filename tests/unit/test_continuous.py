@@ -135,15 +135,13 @@ def test_step_failure_resets_engine(gpt):
     engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(8,))
     engine.add_request([3, 1, 4], 5)
 
-    real_step = engine._step_fn
-
     def exploding(*args, **kwargs):
         raise RuntimeError("synthetic device failure")
 
-    engine._step_fn = exploding
+    engine._step_fns = {False: exploding, True: exploding}
     with pytest.raises(RuntimeError, match="synthetic device failure"):
         engine.step()
-    engine._step_fn = real_step
+    engine._step_fns = {}
 
     assert engine.num_active == 0  # in-flight request abandoned
     assert engine.generate([3, 1, 4], 5) == solo(model, variables, [3, 1, 4], 5)
@@ -157,17 +155,15 @@ def test_step_failure_after_state_assignment_recovers_key(gpt):
     engine = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,))
     engine.add_request([3, 1, 4], 5)
 
-    real_step = engine._step_fn
-
-    def poisoning(variables_, cache, logits, lens, active, key):
+    def poisoning(*args, **kwargs):
         # state vars get assigned garbage, THEN the fetch path raises
         engine._key = object()  # stands in for a poisoned device array
         raise RuntimeError("deferred device failure")
 
-    engine._step_fn = poisoning
+    engine._step_fns = {False: poisoning, True: poisoning}
     with pytest.raises(RuntimeError, match="deferred device failure"):
         engine.step()
-    engine._step_fn = real_step
+    engine._step_fns = {}
 
     assert type(engine._key) is not object  # fresh jax key, not the poisoned stand-in
     assert engine.generate([3, 1, 4], 5) == solo(model, variables, [3, 1, 4], 5)
@@ -452,3 +448,52 @@ def test_batcher_lookahead_matches_solo(gpt):
     results = asyncio.new_event_loop().run_until_complete(go())
     batcher.close()
     assert results == [solo(model, variables, p, n) for p, n in prompts]
+
+
+def test_generate_route_sampling_params(gpt):
+    """HTTP sampling controls: top_k=1 reduces to greedy; bad params 422."""
+    import types
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from unionml_tpu.serving import build_aiohttp_app
+
+    model, variables = gpt
+    stub = types.SimpleNamespace(name="gen-app-sampling", artifact=object())
+    app = build_aiohttp_app(
+        stub,
+        resident=False,
+        coalesce=False,
+        generator=lambda: DecodeEngine(
+            model, variables, num_slots=2, max_len=64, prefill_buckets=(8,)
+        ),
+        generate_lookahead=4,
+    )
+    expected = solo(model, variables, [3, 1, 4], 5)
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/generate",
+                json={"prompt_ids": [3, 1, 4], "max_new_tokens": 5,
+                      "temperature": 0.9, "top_k": 1},
+            )
+            assert resp.status == 200, await resp.text()
+            assert (await resp.json())["tokens"] == expected
+
+            for bad in (
+                {"temperature": -1},
+                {"top_k": -2},
+                {"top_p": 0},
+                {"top_p": "high"},
+            ):
+                resp = await client.post(
+                    "/generate", json={"prompt_ids": [3, 1, 4], "max_new_tokens": 2, **bad}
+                )
+                assert resp.status == 422, (bad, await resp.text())
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(main())

@@ -1,0 +1,175 @@
+"""Sampling transforms (temperature / top-k / top-p) and their engine integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.ops.sampling import apply_top_k, apply_top_p, sample_logits
+
+
+def test_top_k_masks_all_but_k():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.0], [5.0, 4.0, 3.0, 2.0]])
+    out = apply_top_k(logits, jnp.asarray([2, 1]))
+    np.testing.assert_array_equal(
+        np.isfinite(np.asarray(out)),
+        [[False, True, True, False], [True, False, False, False]],
+    )
+    # kept logits unchanged
+    assert float(out[0, 1]) == 3.0 and float(out[1, 0]) == 5.0
+
+
+def test_top_k_zero_disables():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.0]])
+    np.testing.assert_array_equal(np.asarray(apply_top_k(logits, jnp.asarray([0]))), np.asarray(logits))
+
+
+def test_top_k_ties_at_threshold_kept():
+    logits = jnp.asarray([[2.0, 2.0, 1.0]])
+    out = apply_top_k(logits, jnp.asarray([1]))
+    # both tied maxima survive (standard tie behavior for threshold masking)
+    np.testing.assert_array_equal(np.isfinite(np.asarray(out)), [[True, True, False]])
+
+
+def test_top_p_keeps_smallest_covering_prefix():
+    # probs ~ [0.643, 0.236, 0.087, 0.032] -> top_p=0.7 keeps the first two
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+    out = apply_top_p(logits, jnp.asarray([0.7]))
+    np.testing.assert_array_equal(np.isfinite(np.asarray(out)), [[True, True, False, False]])
+
+
+def test_top_p_always_keeps_argmax():
+    logits = jnp.asarray([[0.1, 4.0, 0.2, 0.3]])
+    out = apply_top_p(logits, jnp.asarray([1e-6]))
+    np.testing.assert_array_equal(np.isfinite(np.asarray(out)), [[False, True, False, False]])
+
+
+def test_top_p_one_disables():
+    logits = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+    np.testing.assert_array_equal(np.asarray(apply_top_p(logits, jnp.asarray([1.0]))), np.asarray(logits))
+
+
+def test_sample_logits_greedy_rows_ignore_key():
+    logits = jnp.asarray([[1.0, 5.0, 2.0], [9.0, 0.0, 1.0]])
+    for seed in range(3):
+        out = sample_logits(logits, jax.random.PRNGKey(seed), jnp.asarray([0.0, 0.0]))
+        np.testing.assert_array_equal(np.asarray(out), [1, 0])
+
+
+def test_sample_logits_top_k_one_is_greedy():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 64)), dtype=jnp.float32)
+    out = sample_logits(
+        logits, jax.random.PRNGKey(7), jnp.full((4,), 1.3), top_k=jnp.asarray([1] * 4)
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.argmax(np.asarray(logits), -1))
+
+
+def test_sample_logits_respects_top_k_support():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(2, 32)), dtype=jnp.float32)
+    top2 = np.argsort(np.asarray(logits), -1)[:, -2:]
+    for seed in range(20):
+        out = np.asarray(
+            sample_logits(
+                logits, jax.random.PRNGKey(seed), jnp.full((2,), 2.0), top_k=jnp.asarray([2, 2])
+            )
+        )
+        for row in range(2):
+            assert out[row] in top2[row]
+
+
+def test_sample_logits_mixed_rows():
+    """One greedy row and one sampled row coexist in a single call."""
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.normal(size=(2, 16)), dtype=jnp.float32)
+    greedy_tok = int(np.argmax(np.asarray(logits)[0]))
+    for seed in range(5):
+        out = np.asarray(
+            sample_logits(logits, jax.random.PRNGKey(seed), jnp.asarray([0.0, 2.0]))
+        )
+        assert out[0] == greedy_tok
+
+
+# ------------------------------------------------------------- engine integration
+
+
+CONFIG = None
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    from unionml_tpu.models import GPTConfig, GPTLMHeadModel
+    from unionml_tpu.models.gpt import init_params
+
+    config = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+    model = GPTLMHeadModel(config)
+    return model, init_params(config, seq_len=16)
+
+
+def test_engine_per_request_top_k_one_matches_greedy(gpt):
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(8,))
+    prompt = [3, 1, 4, 1, 5]
+    greedy = engine.generate(prompt, 6)
+    sampled_k1 = engine.generate(prompt, 6, temperature=0.9, top_k=1)
+    assert sampled_k1 == greedy
+
+
+def test_engine_mixed_sampling_does_not_perturb_greedy_neighbor(gpt):
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64, prefill_buckets=(8,))
+    greedy_prompt, sampled_prompt = [3, 1, 4, 1, 5], [2, 7]
+    expected = engine.generate(greedy_prompt, 6)
+
+    slot_g = engine.add_request(greedy_prompt, 6)
+    engine.add_request(sampled_prompt, 6, temperature=1.2, top_p=0.9)
+    got = []
+    while engine.num_active:
+        for ev in engine.step():
+            if ev.slot == slot_g and ev.emit:
+                got.append(ev.token)
+    assert got == expected
+
+
+def test_engine_sampling_with_lookahead_matches_sequential(gpt):
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    model, variables = gpt
+    prompt = [3, 1, 4, 1, 5]
+    a = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,), seed=3)
+    b = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,), seed=3)
+    seq = a.generate(prompt, 10, temperature=0.8, top_k=50, top_p=0.95)
+    burst = b.generate(prompt, 10, temperature=0.8, top_k=50, top_p=0.95, lookahead=4)
+    assert seq == burst
+
+
+def test_engine_validates_sampling_params(gpt):
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=64, prefill_buckets=(8,))
+    with pytest.raises(ValueError, match="temperature"):
+        engine.add_request([1, 2], 4, temperature=-0.5)
+    with pytest.raises(ValueError, match="top_k"):
+        engine.add_request([1, 2], 4, top_k=-1)
+    with pytest.raises(ValueError, match="top_p"):
+        engine.add_request([1, 2], 4, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        engine.add_request([1, 2], 4, top_p=1.5)
+
+
+def test_oneshot_generate_top_k_one_is_greedy(gpt):
+    from unionml_tpu.models.gpt import generate
+
+    model, variables = gpt
+    ids = jnp.asarray([[3, 1, 4, 1, 5]], dtype=jnp.int32)
+    greedy = generate(model, variables, ids, 6)
+    k1 = generate(model, variables, ids, 6, temperature=0.7, top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, variables, ids, 2, top_p=2.0)

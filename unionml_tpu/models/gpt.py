@@ -281,13 +281,17 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
     prompt_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Autoregressive decoding with a KV cache; one compiled scan, O(1) per token.
 
-    ``temperature=0`` is greedy; otherwise samples with the given temperature.
+    ``temperature=0`` is greedy; otherwise samples with the given temperature,
+    optionally filtered by ``top_k`` (0 = off) / ``top_p`` (1.0 = off) — same
+    semantics as :mod:`unionml_tpu.ops.sampling` and the serving engine.
     ``prompt_mask`` (batch, prompt_len; 1 = real token) batches RAGGED prompts:
     rows must be LEFT-padded, so shorter prompts carry leading pad tokens that
     attention ignores and position embeddings skip — each row decodes exactly as it
@@ -307,6 +311,9 @@ def generate(
         raise ValueError(
             f"max_len ({max_len}) exceeds max_position_embeddings ({config.max_position_embeddings})"
         )
+    from unionml_tpu.ops.sampling import validate_sampling
+
+    _, top_k, top_p = validate_sampling(None, top_k, top_p)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     pad_offsets = None
@@ -325,7 +332,18 @@ def generate(
     def sample(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+        from unionml_tpu.ops.sampling import sample_logits
+
+        rows = logits.shape[0]
+        # statically-disabled filters pass None: sample_logits skips them, so
+        # temperature-only sampling stays a plain categorical (no vocab sorts)
+        return sample_logits(
+            logits,
+            key,
+            jnp.full((rows,), temperature, jnp.float32),
+            jnp.full((rows,), top_k, jnp.int32) if top_k > 0 else None,
+            jnp.full((rows,), top_p, jnp.float32) if top_p < 1.0 else None,
+        )
 
     def decode_step(carry, t):
         cache, logits, key = carry

@@ -1,0 +1,110 @@
+"""Token-sampling transforms for batched decoding (temperature, top-k, top-p).
+
+All transforms are per-row over ``(batch, vocab)`` logits with PER-ROW controls,
+so one compiled program serves slots with heterogeneous request settings (the
+decode engine batches requests with different sampling params into one step).
+Disabled rows pass through untouched: ``top_k == 0`` and ``top_p >= 1`` are
+no-ops, ``temperature == 0`` selects greedy argmax.
+
+TPU notes: filtering uses one descending sort of the logits row (vocab-sized,
+vectorized — microseconds next to the decode matmuls) and masks with ``-inf``,
+which ``jax.random.categorical`` (Gumbel argmax) never selects. Everything is
+shape-static and branch-free, so the program is identical for any mix of
+settings; only the *values* change per step.
+
+Reference surface: the reference (unionai-oss/unionml) has no generation
+sampling — this mirrors the standard text-generation serving contract
+(HF ``generate``'s ``temperature`` / ``top_k`` / ``top_p``) the TPU build's
+GPT family and ``/generate`` route provide.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["apply_top_k", "apply_top_p", "sample_logits", "validate_sampling"]
+
+
+def validate_sampling(temperature=None, top_k=0, top_p=1.0):
+    """Validate and normalize the sampling contract shared by every entry point
+    (HTTP route, ``DecodeEngine.add_request``, ``models.gpt.generate``).
+
+    ``temperature=None`` passes through (the caller's default applies).
+    :returns: ``(temperature, top_k, top_p)`` as ``(Optional[float], int, float)``.
+    :raises ValueError: temperature < 0, top_k < 0, or top_p outside ``(0, 1]``.
+    """
+    if temperature is not None:
+        temperature = float(temperature)
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+    top_k = int(top_k)
+    if top_k < 0:
+        raise ValueError("top_k must be >= 0")
+    top_p = float(top_p)
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError("top_p must be in (0, 1]")
+    return temperature, top_k, top_p
+
+
+def apply_top_k(logits: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Mask each row to its ``top_k[i]`` highest logits (ties at the threshold kept).
+
+    :param logits: ``(batch, vocab)``.
+    :param top_k: ``(batch,)`` int; ``0`` disables the filter for that row.
+    """
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    k = jnp.clip(top_k, 1, vocab)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None].astype(jnp.int32), axis=-1)
+    keep = logits >= kth
+    keep = jnp.where((top_k > 0)[:, None], keep, True)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
+def apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filter: keep each row's smallest prefix of probability mass >= ``top_p[i]``.
+
+    At least one token (the argmax) always survives. ``top_p >= 1`` disables the
+    filter for that row.
+
+    :param logits: ``(batch, vocab)``.
+    :param top_p: ``(batch,)`` float in ``(0, 1]``.
+    """
+    probs = jax.nn.softmax(logits, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # a sorted position is kept while the mass BEFORE it is < top_p, so the
+    # prefix always includes position 0 and stops once mass is covered
+    keep_sorted = (cumulative - sorted_probs) < top_p[:, None]
+    min_kept = jnp.min(jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1, keepdims=True)
+    keep = probs >= min_kept
+    keep = jnp.where((top_p < 1.0)[:, None], keep, True)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    top_k: Optional[jax.Array] = None,
+    top_p: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sample one token per row honoring per-row temperature / top-k / top-p.
+
+    Rows with ``temperature == 0`` take the greedy argmax (of the raw logits);
+    the rest sample from the filtered, temperature-scaled distribution.
+
+    :param logits: ``(batch, vocab)``.
+    :param key: PRNG key consumed for the whole batch.
+    :param temperature: ``(batch,)`` float ``>= 0``.
+    :returns: ``(batch,)`` int32 token ids.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    if top_k is not None:
+        scaled = apply_top_k(scaled, top_k)
+    if top_p is not None:
+        scaled = apply_top_p(scaled, top_p)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

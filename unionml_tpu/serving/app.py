@@ -244,6 +244,26 @@ def build_aiohttp_app(
                 gen.engine.bucket_for(seq.size)
         except (TypeError, ValueError) as exc:
             return web.json_response({"detail": f"invalid prompt payload: {exc}"}, status=422)
+
+        # optional per-request sampling controls (applied to every prompt in a
+        # batch); absent keys defer to the engine's construction-time settings
+        from unionml_tpu.ops.sampling import validate_sampling
+
+        try:
+            temp, top_k, top_p = validate_sampling(
+                payload.get("temperature"),
+                payload.get("top_k") if payload.get("top_k") is not None else 0,
+                payload.get("top_p") if payload.get("top_p") is not None else 1.0,
+            )
+        except (TypeError, ValueError) as exc:
+            return web.json_response({"detail": f"invalid sampling params: {exc}"}, status=422)
+        sampling = {}
+        if payload.get("temperature") is not None:
+            sampling["temperature"] = temp
+        if payload.get("top_k") is not None:
+            sampling["top_k"] = top_k
+        if payload.get("top_p") is not None:
+            sampling["top_p"] = top_p
         stream = bool(payload.get("stream"))
         if stream and prompt_ids is None:
             return web.json_response(
@@ -266,7 +286,9 @@ def build_aiohttp_app(
                 # aclosing guarantees the stream iterator closes promptly on an
                 # early exit (client disconnect -> write raises), which cancels
                 # the request's decode slot
-                async with contextlib.aclosing(gen.stream(prompt_ids, max_new)) as stream_it:
+                async with contextlib.aclosing(
+                    gen.stream(prompt_ids, max_new, **sampling)
+                ) as stream_it:
                     async for token in stream_it:
                         tokens.append(token)
                         await response.write((_json.dumps({"token": token}) + "\n").encode())
@@ -286,10 +308,10 @@ def build_aiohttp_app(
             return response
         try:
             if prompt_ids is not None:
-                tokens = await gen.generate(prompt_ids, max_new)
+                tokens = await gen.generate(prompt_ids, max_new, **sampling)
                 return web.json_response({"tokens": tokens})
             completions = await asyncio.gather(
-                *(gen.generate(p, max_new) for p in prompts)
+                *(gen.generate(p, max_new, **sampling) for p in prompts)
             )
             return web.json_response({"completions": list(completions)})
         except ValueError as exc:  # bad request (empty/oversized prompt, bad budget)

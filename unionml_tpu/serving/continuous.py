@@ -133,21 +133,28 @@ class DecodeEngine:
         self._active = np.zeros(num_slots, dtype=bool)
         self._lens_host = np.zeros(num_slots, dtype=np.int64)
         self._remaining = np.zeros(num_slots, dtype=np.int64)
+        # per-slot sampling controls (requests may override the engine defaults)
+        self._slot_temp = np.full(num_slots, self.temperature, dtype=np.float32)
+        self._slot_top_k = np.zeros(num_slots, dtype=np.int32)
+        self._slot_top_p = np.ones(num_slots, dtype=np.float32)
 
-        temperature_ = self.temperature
+        def _decode_body(variables, cache, last_logits, lens, active, key, temp, top_k, top_p, *, sampling):
+            """One decode step — the single shared body for the single-step fns AND
+            the lookahead scans, so sampling/freeze rules cannot drift between them.
 
-        def _decode_body(variables, cache, last_logits, lens, active, key):
-            """One decode step — the single shared body for ``_step_fn`` AND the
-            lookahead scan, so sampling/freeze rules cannot drift between them."""
+            ``sampling`` is a trace-time switch: the all-greedy program skips the
+            sort/softmax sampling machinery entirely; the sampling program honors
+            per-slot temperature/top-k/top-p (greedy rows via ``temperature == 0``).
+            """
+            from unionml_tpu.ops.sampling import sample_logits
+
             # dequant here (not hoisted) so weight reads stay int8 in HBM
             variables = maybe_dequant(variables)
             key, subkey = jax.random.split(key)
-            if temperature_ <= 0.0:
-                tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            if sampling:
+                tokens = sample_logits(last_logits, subkey, temp, top_k, top_p)
             else:
-                tokens = jax.random.categorical(
-                    subkey, last_logits / temperature_, axis=-1
-                ).astype(jnp.int32)
+                tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
             logits, cache = model.apply(variables, tokens[:, None], cache=cache, position=lens)
             # inactive rows freeze: length and logits unchanged, their (ignored)
             # cache write lands on a column their own future prefill/decode rewrites
@@ -155,7 +162,17 @@ class DecodeEngine:
             new_logits = jnp.where(active[:, None], logits[:, -1, :], last_logits)
             return cache, new_logits, new_lens, tokens, key
 
-        self._step_fn = jax.jit(_decode_body, donate_argnums=(1, 2))
+        def _make_step(sampling: bool):
+            def _fn(variables, cache, last_logits, lens, active, key, temp, top_k, top_p):
+                return _decode_body(
+                    variables, cache, last_logits, lens, active, key, temp, top_k, top_p,
+                    sampling=sampling,
+                )
+
+            return jax.jit(_fn, donate_argnums=(1, 2))
+
+        self._make_step = _make_step
+        self._step_fns: Dict[bool, Any] = {}
 
         def _prefill(variables, prompt_ids, length):
             variables = maybe_dequant(variables)
@@ -180,7 +197,7 @@ class DecodeEngine:
 
         self._insert_fn = jax.jit(_insert, donate_argnums=(0, 1, 2))
 
-        def _make_multi_step(n_steps: int):
+        def _make_multi_step(n_steps: int, sampling: bool):
             """K decode steps fused into one device program (``lax.scan``).
 
             One host↔device round-trip per K tokens instead of per token: the
@@ -192,11 +209,12 @@ class DecodeEngine:
             replays the fetched token matrix to update its mirrors identically.
             """
 
-            def _multi(variables, cache, last_logits, lens, active, remaining, key):
+            def _multi(variables, cache, last_logits, lens, active, remaining, key, temp, top_k, top_p):
                 def body(carry, _):
                     cache, last_logits, lens, active, remaining, key = carry
                     cache, new_logits, new_lens, tokens, key = _decode_body(
-                        variables, cache, last_logits, lens, active, key
+                        variables, cache, last_logits, lens, active, key, temp, top_k, top_p,
+                        sampling=sampling,
                     )
                     new_remaining = jnp.where(active, remaining - 1, remaining)
                     finished = (new_remaining <= 0) | (new_lens >= max_len - 1)
@@ -215,7 +233,7 @@ class DecodeEngine:
             return jax.jit(_multi, donate_argnums=(1, 2))
 
         self._make_multi_step = _make_multi_step
-        self._scan_fns: Dict[int, Any] = {}
+        self._scan_fns: Dict[Tuple[int, bool], Any] = {}
 
     # ------------------------------------------------------------------ scheduling
 
@@ -236,8 +254,21 @@ class DecodeEngine:
             f"({self._buckets[-1]}); raise prefill_buckets/max_len or truncate"
         )
 
-    def add_request(self, prompt_ids: Sequence[int], max_new_tokens: int) -> int:
+    def add_request(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        temperature: Optional[float] = None,
+        top_k: int = 0,
+        top_p: float = 1.0,
+    ) -> int:
         """Prefill ``prompt_ids`` into a free slot; returns the slot index.
+
+        ``temperature`` (``None`` = the engine default), ``top_k`` (``0`` = off)
+        and ``top_p`` (``1.0`` = off) set THIS request's sampling controls; slots
+        with heterogeneous settings share every decode step (one program, per-row
+        controls — :mod:`unionml_tpu.ops.sampling`).
 
         Raises ``RuntimeError`` when no slot is free (callers should gate on
         ``free_slots``) and ``ValueError`` for empty/oversized prompts. The
@@ -251,6 +282,10 @@ class DecodeEngine:
             raise ValueError("max_new_tokens must be >= 1")
         if prompt.size >= self.max_len:
             raise ValueError(f"prompt length {prompt.size} >= max_len ({self.max_len})")
+        from unionml_tpu.ops.sampling import validate_sampling
+
+        temperature, top_k, top_p = validate_sampling(temperature, top_k, top_p)
+        temperature = self.temperature if temperature is None else temperature
         free = self.free_slots
         if not free:
             raise RuntimeError("no free decode slots")
@@ -268,6 +303,9 @@ class DecodeEngine:
         self._active[slot] = True
         self._lens_host[slot] = prompt.size
         self._remaining[slot] = max_new_tokens
+        self._slot_temp[slot] = temperature
+        self._slot_top_k[slot] = int(top_k)
+        self._slot_top_p[slot] = float(top_p)
         return slot
 
     def reset(self) -> None:
@@ -290,6 +328,9 @@ class DecodeEngine:
         self._active[:] = False
         self._lens_host[:] = 0
         self._remaining[:] = 0
+        self._slot_temp[:] = self.temperature
+        self._slot_top_k[:] = 0
+        self._slot_top_p[:] = 1.0
 
     def _apply_token(self, slot: int, token: int) -> StepEvent:
         """Advance the host mirrors for one decoded token (same rules as on device)."""
@@ -334,11 +375,21 @@ class DecodeEngine:
             needed = max(1, int(room.max()))
             if needed < lookahead:
                 lookahead = min(lookahead, 1 << (needed - 1).bit_length())
+        # the all-greedy program skips the sampling machinery; heterogeneous slots
+        # share the sampling program with per-row controls
+        sampling = bool((self._slot_temp[self._active] > 0).any())
+        active_dev = jnp.asarray(self._active)
+        temp_dev = jnp.asarray(self._slot_temp)
+        top_k_dev = jnp.asarray(self._slot_top_k)
+        top_p_dev = jnp.asarray(self._slot_top_p)
         if lookahead == 1:
-            active_dev = jnp.asarray(self._active)
+            fn = self._step_fns.get(sampling)
+            if fn is None:
+                fn = self._step_fns[sampling] = self._make_step(sampling)
             try:
-                self._cache, self._last_logits, self._lens, tokens, self._key = self._step_fn(
-                    self._variables, self._cache, self._last_logits, self._lens, active_dev, self._key
+                self._cache, self._last_logits, self._lens, tokens, self._key = fn(
+                    self._variables, self._cache, self._last_logits, self._lens,
+                    active_dev, self._key, temp_dev, top_k_dev, top_p_dev,
                 )
                 tokens_host = np.asarray(jax.device_get(tokens))  # hard sync (see utils.hard_sync)
             except Exception:
@@ -349,10 +400,9 @@ class DecodeEngine:
                 for slot in np.flatnonzero(self._active)
             ]
 
-        fn = self._scan_fns.get(lookahead)
+        fn = self._scan_fns.get((lookahead, sampling))
         if fn is None:
-            fn = self._scan_fns[lookahead] = self._make_multi_step(lookahead)
-        active_dev = jnp.asarray(self._active)
+            fn = self._scan_fns[(lookahead, sampling)] = self._make_multi_step(lookahead, sampling)
         remaining_dev = jnp.asarray(
             np.minimum(self._remaining, np.iinfo(np.int32).max), dtype=jnp.int32
         )
@@ -366,7 +416,7 @@ class DecodeEngine:
                 masks,
             ) = fn(
                 self._variables, self._cache, self._last_logits, self._lens,
-                active_dev, remaining_dev, self._key,
+                active_dev, remaining_dev, self._key, temp_dev, top_k_dev, top_p_dev,
             )
             tokens_host = np.asarray(jax.device_get(tokens))
             masks_host = np.asarray(jax.device_get(masks))
@@ -390,11 +440,20 @@ class DecodeEngine:
         self._active[slot] = False
 
     def generate(
-        self, prompt_ids: Sequence[int], max_new_tokens: int, *, lookahead: int = 1
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        lookahead: int = 1,
+        temperature: Optional[float] = None,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> List[int]:
         """Single-request convenience driver (tests/scripts): run one request to
         completion on an otherwise-idle engine and return its emitted tokens."""
-        slot = self.add_request(prompt_ids, max_new_tokens)
+        slot = self.add_request(
+            prompt_ids, max_new_tokens, temperature=temperature, top_k=top_k, top_p=top_p
+        )
         out: List[int] = []
         while self._active[slot]:
             for event in self.step(lookahead):
@@ -470,7 +529,7 @@ class ContinuousBatcher:
     def __init__(self, engine: DecodeEngine, *, lookahead: int = 1) -> None:
         self._engine = engine
         self._lookahead = max(1, int(lookahead))
-        self._pending: "collections.deque[Tuple[np.ndarray, int, Any]]" = collections.deque()
+        self._pending: "collections.deque[Tuple[np.ndarray, int, Dict[str, Any], Any]]" = collections.deque()
         self._sinks: Dict[int, Any] = {}
         self._lock = threading.Lock()
         self._work = threading.Event()
@@ -486,7 +545,9 @@ class ContinuousBatcher:
             self._worker = threading.Thread(target=self._run, name="continuous-batcher", daemon=True)
             self._worker.start()
 
-    def _submit(self, prompt_ids: Sequence[int], max_new_tokens: int, sink: Any) -> None:
+    def _submit(
+        self, prompt_ids: Sequence[int], max_new_tokens: int, sink: Any, sampling: Optional[Dict[str, Any]] = None
+    ) -> None:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         # surface bad requests on the caller's side, not the worker's
         if prompt.size == 0:
@@ -495,17 +556,19 @@ class ContinuousBatcher:
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._pending.append((prompt, int(max_new_tokens), sink))
+            self._pending.append((prompt, int(max_new_tokens), sampling or {}, sink))
         self._ensure_worker()
         self._work.set()
 
-    async def generate(self, prompt_ids: Sequence[int], max_new_tokens: int) -> List[int]:
+    async def generate(
+        self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling
+    ) -> List[int]:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
-        self._submit(prompt_ids, max_new_tokens, _FutureSink(loop, future))
+        self._submit(prompt_ids, max_new_tokens, _FutureSink(loop, future), sampling)
         return await future
 
-    async def stream(self, prompt_ids: Sequence[int], max_new_tokens: int):
+    async def stream(self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling):
         """Async iterator of tokens, yielded as the engine decodes them.
 
         The request shares slots (and decode steps) with every other in-flight
@@ -515,7 +578,7 @@ class ContinuousBatcher:
         loop = asyncio.get_running_loop()
         queue: "asyncio.Queue" = asyncio.Queue()
         sink = _QueueSink(loop, queue)
-        self._submit(prompt_ids, max_new_tokens, sink)
+        self._submit(prompt_ids, max_new_tokens, sink, sampling)
         try:
             while True:
                 item = await queue.get()
@@ -548,11 +611,11 @@ class ContinuousBatcher:
             with self._lock:
                 if not self._pending or not self._engine.free_slots:
                     return
-                prompt, budget, sink = self._pending.popleft()
+                prompt, budget, sampling, sink = self._pending.popleft()
             if sink.cancelled:  # consumer gave up while queued
                 continue
             try:
-                slot = self._engine.add_request(prompt, budget)
+                slot = self._engine.add_request(prompt, budget, **sampling)
             except Exception as exc:  # reject this request, keep serving others
                 self._deliver(sink, "fail", exc)
                 continue
