@@ -135,8 +135,8 @@ def test_pick_block_sizes_alignment():
     from unionml_tpu.ops.tuning import TUNED_BLOCKS, pick_block_sizes
 
     assert pick_block_sizes(128, 128, 64) == (128, 128)
-    # v5e-measured winner (KERNEL_BENCH.json 2026-07-29): fwd+bwd 11.48ms vs XLA 14.63ms
-    assert pick_block_sizes(512, 512, 64) == (256, 128)
+    # v5e-measured winner (on-device scanned sweep, KERNEL_BENCH.json 2026-07-29)
+    assert pick_block_sizes(512, 512, 64) == (256, 512)
     assert pick_block_sizes(96, 96, 64) == (96, 96)  # tiny seq: one block
     # irregular (non-multiple-of-8) seqs get NON-dividing blocks so the kernel's
     # alignment check routes to the XLA fallback instead of a doomed Mosaic compile
@@ -159,3 +159,13 @@ def test_flash_attention_default_blocks_resolve(qkv):
     out = flash_attention(q, k, v, interpret=True)
     ref = xla_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_pick_impl_measured_and_default():
+    """auto dispatch consults measured verdicts; unmeasured shapes use the default."""
+    from unionml_tpu.ops.tuning import DEFAULT_TPU_IMPL, MEASURED_IMPL, pick_impl
+
+    assert pick_impl(128, 128, 64) == "xla"  # end-to-end arbiter, TPU_PROBES.log
+    for shape, impl in MEASURED_IMPL.items():
+        assert pick_impl(*shape) == impl
+    assert pick_impl(384, 384, 64) == DEFAULT_TPU_IMPL

@@ -94,8 +94,6 @@ def test_sample_logits_mixed_rows():
 # ------------------------------------------------------------- engine integration
 
 
-CONFIG = None
-
 
 @pytest.fixture(scope="module")
 def gpt():

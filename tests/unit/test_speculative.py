@@ -1,0 +1,135 @@
+"""Speculative decoding: greedy exactness, cache discipline, the accept rule.
+
+The gold property: greedy speculative output equals target-only greedy decoding
+token for token, for ANY draft — good, identical, or adversarially bad — at any
+gamma. The draft can only change speed, never content.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.models import GPTConfig, GPTLMHeadModel
+from unionml_tpu.models.gpt import generate, init_params
+from unionml_tpu.models.speculative import speculative_generate
+
+CONFIG = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+
+
+@pytest.fixture(scope="module")
+def target():
+    model = GPTLMHeadModel(CONFIG)
+    return model, init_params(CONFIG, rng=jax.random.PRNGKey(0), seq_len=16)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    """A DIFFERENT model (own weights) sharing the vocab — the realistic case."""
+    model = GPTLMHeadModel(CONFIG)
+    return model, init_params(CONFIG, rng=jax.random.PRNGKey(42), seq_len=16)
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 4, 7])
+def test_greedy_equals_target_only(target, draft, gamma):
+    t_model, t_vars = target
+    d_model, d_vars = draft
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], dtype=jnp.int32)
+    expected = generate(t_model, t_vars, prompt, 12)
+    got = speculative_generate(t_model, t_vars, d_model, d_vars, prompt, 12, gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_greedy_equality_across_prompts_and_lengths(target, draft):
+    t_model, t_vars = target
+    d_model, d_vars = draft
+    for prompt, n in (([2], 9), ([7, 7, 7, 7, 7, 7, 7], 5), ([1, 2, 3], 17)):
+        ids = jnp.asarray([prompt], dtype=jnp.int32)
+        expected = generate(t_model, t_vars, ids, n)
+        got = speculative_generate(t_model, t_vars, d_model, d_vars, ids, n, gamma=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_self_draft_accepts_everything(target):
+    """Draft == target: every greedy proposal matches, acceptance rate 1.0."""
+    t_model, t_vars = target
+    prompt = jnp.asarray([[3, 1, 4]], dtype=jnp.int32)
+    expected = generate(t_model, t_vars, prompt, 10)
+    got, stats = speculative_generate(
+        t_model, t_vars, t_model, t_vars, prompt, 10, gamma=4, return_stats=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+    assert stats["acceptance_rate"] == 1.0
+    # full-accept rounds advance gamma+1 tokens: 10 tokens in ceil(9/5)+... few rounds
+    assert stats["rounds"] <= 2
+
+
+def test_adversarial_draft_still_exact(target):
+    """A draft with garbage weights rejects constantly; output is still exact."""
+    t_model, t_vars = target
+    d_model = GPTLMHeadModel(CONFIG)
+    d_vars = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(np.random.default_rng(9).normal(size=x.shape), x.dtype), t_vars
+    )
+    prompt = jnp.asarray([[5, 4, 3, 2]], dtype=jnp.int32)
+    expected = generate(t_model, t_vars, prompt, 8)
+    got, stats = speculative_generate(
+        t_model, t_vars, d_model, d_vars, prompt, 8, gamma=4, return_stats=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+    assert stats["acceptance_rate"] < 1.0  # garbage draft can't ride for free
+
+
+def test_sampled_self_draft_accepts_everything(target):
+    """temperature>0 with draft == target: accept prob is min(1, 1) -> all accepted."""
+    t_model, t_vars = target
+    prompt = jnp.asarray([[3, 1, 4]], dtype=jnp.int32)
+    out, stats = speculative_generate(
+        t_model, t_vars, t_model, t_vars, prompt, 12, gamma=4,
+        temperature=1.0, rng=jax.random.PRNGKey(5), return_stats=True,
+    )
+    assert out.shape == (1, 3 + 12)
+    assert stats["acceptance_rate"] == 1.0
+    assert int(np.asarray(out).max()) < CONFIG.vocab_size
+
+
+def test_sampled_distribution_matches_target():
+    """Two-sample check: speculative sampling's tokens come from the target
+    distribution (small vocab so empirical TV distance is meaningful)."""
+    config = GPTConfig.tiny(vocab_size=8, dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+    t_model = GPTLMHeadModel(config)
+    t_vars = init_params(config, rng=jax.random.PRNGKey(0), seq_len=8)
+    d_model = GPTLMHeadModel(config)
+    d_vars = init_params(config, rng=jax.random.PRNGKey(99), seq_len=8)
+    prompt = jnp.asarray([[1, 2]], dtype=jnp.int32)
+
+    n = 150
+    spec = np.zeros(8)
+    ref = np.zeros(8)
+    for seed in range(n):
+        s = speculative_generate(
+            t_model, t_vars, d_model, d_vars, prompt, 3, gamma=2,
+            temperature=1.0, rng=jax.random.PRNGKey(seed),
+        )
+        spec[int(np.asarray(s)[0, -1])] += 1
+        r = generate(t_model, t_vars, prompt, 3, temperature=1.0, rng=jax.random.PRNGKey(10_000 + seed))
+        ref[int(np.asarray(r)[0, -1])] += 1
+    tv = 0.5 * np.abs(spec / n - ref / n).sum()
+    assert tv < 0.25, (tv, spec, ref)
+
+
+def test_validation_errors(target, draft):
+    t_model, t_vars = target
+    d_model, d_vars = draft
+    ok = jnp.asarray([[1, 2]], dtype=jnp.int32)
+    with pytest.raises(ValueError, match=r"\(1, prompt_len\)"):
+        speculative_generate(t_model, t_vars, d_model, d_vars, jnp.zeros((2, 3), jnp.int32), 4)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(t_model, t_vars, d_model, d_vars, ok, 4, gamma=0)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        speculative_generate(t_model, t_vars, d_model, d_vars, ok, 10_000)
+    small = GPTConfig.tiny(vocab_size=64, dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+    s_model = GPTLMHeadModel(small)
+    s_vars = init_params(small, seq_len=8)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(t_model, t_vars, s_model, s_vars, ok, 4)

@@ -313,7 +313,7 @@ def generate(
         )
     from unionml_tpu.ops.sampling import validate_sampling
 
-    _, top_k, top_p = validate_sampling(None, top_k, top_p)
+    temperature, top_k, top_p = validate_sampling(temperature, top_k, top_p)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     pad_offsets = None

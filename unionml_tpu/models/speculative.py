@@ -1,0 +1,213 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Autoregressive decoding runs one token per target-model forward; at decode
+batch 1 the MXU is idle most of the step (weight streaming dominates).
+Speculative decoding (Leviathan et al. 2023) restores arithmetic intensity the
+TPU-friendly way: a cheap DRAFT model decodes ``gamma`` proposal tokens, then
+the TARGET scores all of them in ONE chunked forward — a (gamma+1)-token matmul
+instead of gamma+1 sequential single-token steps. Accepted prefixes advance the
+sequence several tokens per target pass.
+
+Guarantees:
+
+- ``temperature=0`` (greedy): output is EXACTLY what target-only greedy decoding
+  produces, token for token, for any draft model — the draft only affects speed.
+  (Verification compares the target's argmax against each proposal and truncates
+  at the first mismatch, emitting the target's own token there.)
+- ``temperature>0``: the standard accept/residual rule — accept proposal ``x``
+  with probability ``min(1, p_target(x)/p_draft(x))``, on rejection sample from
+  the normalized positive residual ``max(p_target - p_draft, 0)`` — which makes
+  each emitted token an exact sample from the target distribution.
+
+Cache discipline (both models): after every round the KV caches are valid for
+positions ``[0, n)`` where ``n`` counts tokens *fed*; the latest emitted token
+is NOT yet fed (its K/V enters the cache at the start of the next round, as the
+first element of the proposal/verification chunk). Rejected speculative columns
+beyond ``n`` are never attended — the chunked decode mask is position-based
+(``models/gpt.py`` DecoderBlock) — and are overwritten by later rounds.
+
+Reference: the reference framework (unionai-oss/unionml) has no generation
+machinery at all; this extends the TPU build's GPT family
+(``models/gpt.py::generate``) with a lossless latency optimization.
+"""
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["speculative_generate"]
+
+
+def _prefill(model, variables, prompt_ids, max_len):
+    from unionml_tpu.models.gpt import init_cache
+
+    cache = init_cache(model.config, prompt_ids.shape[0], max_len)
+    logits, cache = model.apply(variables, prompt_ids, cache=cache, position=0)
+    return cache, logits[:, -1, :]
+
+
+def speculative_generate(
+    target: Any,
+    target_variables: Any,
+    draft: Any,
+    draft_variables: Any,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    return_stats: bool = False,
+) -> Any:
+    """Decode ``max_new_tokens`` from ``target`` using ``draft`` speculation.
+
+    :param target: the model whose output distribution is authoritative
+        (:class:`~unionml_tpu.models.gpt.GPTLMHeadModel` or compatible).
+    :param draft: a cheaper model sharing the target's vocabulary.
+    :param prompt_ids: ``(1, prompt_len)`` int32 — batch 1 (rows would accept
+        different prefix lengths and diverge positionally; batched speculation
+        needs per-row chunk positions the cache layout doesn't support yet).
+    :param gamma: proposal tokens per round; each round costs one draft scan of
+        ``gamma`` steps plus ONE target forward over ``gamma+1`` tokens and
+        advances 1..gamma+1 tokens.
+    :param return_stats: also return ``{"rounds", "proposed", "accepted",
+        "acceptance_rate"}`` (bonus/correction tokens are not counted as
+        accepted proposals).
+    :returns: ``(1, prompt_len + max_new_tokens)`` ids — same contract as
+        :func:`unionml_tpu.models.gpt.generate` — or ``(ids, stats)``.
+    """
+    if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
+        raise ValueError(f"speculative_generate expects (1, prompt_len) ids; got {prompt_ids.shape}")
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+    if target.config.vocab_size != draft.config.vocab_size:
+        raise ValueError(
+            f"draft vocab ({draft.config.vocab_size}) must match target ({target.config.vocab_size})"
+        )
+    prompt_len = prompt_ids.shape[1]
+    # speculation overshoots by up to gamma rejected columns; reserve the slack
+    max_len = prompt_len + max_new_tokens + gamma + 1
+    for cfg, name in ((target.config, "target"), (draft.config, "draft")):
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt + max_new_tokens + gamma ({max_len}) exceeds the {name}'s "
+                f"max_position_embeddings ({cfg.max_position_embeddings})"
+            )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    greedy = temperature <= 0.0
+
+    def select(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    @jax.jit
+    def propose(draft_vars, cache, feed2, n_minus1, key):
+        """Feed the last two committed tokens, then draft-decode gamma proposals,
+        returning the logits row each was drawn from (the sampled accept rule
+        needs the true proposal distribution).
+
+        Why two: a full-accept round leaves the draft's cache missing the final
+        proposal's K/V (verify feeds gamma+1 tokens to the target but propose fed
+        only gamma to the draft); re-feeding the penultimate token backfills that
+        hole with identical values in every other case (deterministic K/V of the
+        same prefix), keeping the chunk shape static."""
+        logits2, cache = draft.apply(draft_vars, feed2, cache=cache, position=n_minus1)
+        key, sub = jax.random.split(key)
+        first_logits = logits2[:, -1, :]
+        p1 = select(first_logits, sub)
+
+        def step(carry, _):
+            cache, token, pos, key = carry
+            key, sub = jax.random.split(key)
+            logits, cache = draft.apply(draft_vars, token[:, None], cache=cache, position=pos)
+            logits = logits[:, -1, :]
+            nxt = select(logits, sub)
+            return (cache, nxt, pos + 1, key), (nxt[0], logits[0])
+
+        (cache, _, _, key), (rest, rest_rows) = jax.lax.scan(
+            step, (cache, p1, n_minus1 + 2, key), None, length=gamma - 1
+        )
+        proposals = jnp.concatenate([p1, rest])
+        logit_rows = jnp.concatenate([first_logits, rest_rows])
+        return proposals, logit_rows, cache, key
+
+    @jax.jit
+    def verify(target_vars, cache, t_last, proposals, draft_logits, n, key):
+        """One chunked target forward over [t_last, proposals]; returns the
+        accepted count, the gamma+1 emission row, and the updated cache."""
+        chunk = jnp.concatenate([t_last, proposals])[None, :]  # (1, gamma+1)
+        logits, cache = target.apply(target_vars, chunk, cache=cache, position=n)
+        rows = logits[0]  # (gamma+1, vocab): rows[i] follows chunk[i]
+        if greedy:
+            preds = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+            accept = jnp.cumprod((preds[:-1] == proposals).astype(jnp.int32))
+            a = jnp.sum(accept)
+            emitted = jnp.where(jnp.arange(gamma) < a, proposals, 0)
+            closer = preds[a]  # correction on mismatch; bonus when a == gamma
+        else:
+            p_t = jax.nn.softmax(rows[:-1] / temperature, axis=-1)  # (gamma, vocab)
+            p_d = jax.nn.softmax(draft_logits / temperature, axis=-1)
+            idx = jnp.arange(gamma)
+            pt_x = p_t[idx, proposals]
+            pd_x = p_d[idx, proposals]
+            key, k_accept, k_resid, k_bonus = jax.random.split(key, 4)
+            u = jax.random.uniform(k_accept, (gamma,))
+            ok = u * pd_x < pt_x  # u < p_t/p_d without the 0/0 division
+            accept = jnp.cumprod(ok.astype(jnp.int32))
+            a = jnp.sum(accept)
+            emitted = jnp.where(jnp.arange(gamma) < a, proposals, 0)
+            # rejection at position a: sample the normalized positive residual
+            resid = jnp.maximum(p_t[jnp.minimum(a, gamma - 1)] - p_d[jnp.minimum(a, gamma - 1)], 0.0)
+            resid = resid / jnp.maximum(jnp.sum(resid), 1e-20)
+            resid_tok = jax.random.categorical(k_resid, jnp.log(resid + 1e-20)).astype(jnp.int32)
+            bonus_tok = select(rows[-1][None, :], k_bonus)[0]
+            closer = jnp.where(a == gamma, bonus_tok, resid_tok)
+        emissions = jnp.concatenate([emitted, jnp.zeros((1,), jnp.int32)])
+        emissions = emissions.at[a].set(closer)
+        return a, emissions, cache, key
+
+    # --- prefill both models, emit the first token from the target alone
+    target_cache, t_logits = _prefill(target, target_variables, prompt_ids, max_len)
+    draft_cache, _ = _prefill(draft, draft_variables, prompt_ids, max_len)
+    rng, sub = jax.random.split(rng)
+    t_last = select(t_logits, sub)  # (1,)
+
+    emitted = [int(t_last[0])]
+    prev = int(prompt_ids[0, -1])  # penultimate committed token (see propose)
+    n = prompt_len
+    rounds = accepted_total = 0
+    while len(emitted) < max_new_tokens:
+        feed2 = jnp.asarray([[prev, emitted[-1]]], jnp.int32)
+        n_dev = jnp.asarray(n, jnp.int32)
+        proposals, draft_logit_rows, draft_cache, rng = propose(
+            draft_variables, draft_cache, feed2, n_dev - 1, rng
+        )
+        a, emissions, target_cache, rng = verify(
+            target_variables, target_cache, t_last, proposals, draft_logit_rows, n_dev, rng
+        )
+        a = int(a)
+        take = a + 1
+        new_tokens = [int(t) for t in np.asarray(jax.device_get(emissions))[:take]]
+        emitted.extend(new_tokens)
+        prev = emitted[-2]
+        t_last = jnp.asarray([emitted[-1]], jnp.int32)
+        n += take
+        rounds += 1
+        accepted_total += a
+
+    out = jnp.concatenate(
+        [prompt_ids, jnp.asarray(emitted[:max_new_tokens], jnp.int32)[None, :]], axis=1
+    )
+    if return_stats:
+        proposed = rounds * gamma
+        stats = {
+            "rounds": rounds,
+            "proposed": proposed,
+            "accepted": accepted_total,
+            "acceptance_rate": accepted_total / proposed if proposed else 0.0,
+        }
+        return out, stats
+    return out

@@ -500,12 +500,20 @@ def attention(
 ) -> jax.Array:
     """Dispatching attention entrypoint used by the model zoo.
 
-    ``impl="auto"`` picks the pallas kernel on TPU (dense ``mask`` arrays force XLA —
-    the kernel handles the causal and per-batch-length padding cases) and the XLA path
-    elsewhere.
+    ``impl="auto"`` consults the measured per-shape verdicts
+    (:data:`unionml_tpu.ops.tuning.MEASURED_IMPL` — on v5e, XLA's fused attention
+    wins or ties the pallas kernel at every measured practical shape, confirmed
+    end-to-end by a 24% faster BERT-base train step; TPU_PROBES.log 2026-07-29).
+    Dense ``mask`` arrays and non-TPU backends always take the XLA path;
+    ``impl="pallas"`` forces the flash kernel with its tuned block sizes.
     """
     if impl == "auto":
-        impl = "pallas" if (on_tpu() and mask is None) else "xla"
+        if on_tpu() and mask is None:
+            from unionml_tpu.ops.tuning import pick_impl
+
+            impl = pick_impl(q.shape[-2], k.shape[-2], q.shape[-1])
+        else:
+            impl = "xla"
     if impl == "pallas":
         if mask is not None:
             raise ValueError(

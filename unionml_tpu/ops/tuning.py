@@ -16,12 +16,37 @@ from typing import Dict, Tuple
 #: measured winners — populated from bench_kernels.py runs on real TPU hardware.
 #: Format: {(seq_q, seq_k, head_dim): (block_q, block_k)}
 TUNED_BLOCKS: Dict[Tuple[int, int, int], Tuple[int, int]] = {
-    # Measured on v5e (axon tunnel window 2026-07-29T13:53Z, KERNEL_BENCH.json):
-    # seq 128: only (128,128) tiles; fwd+bwd 12.35ms vs XLA 12.72ms -> pallas.
-    # seq 512: (256,128) wins fwd+bwd 11.48ms vs XLA 14.63ms (fwd 4.43 vs 11.10).
+    # Measured on v5e via the ON-DEVICE scanned sweep (KERNEL_BENCH.json,
+    # 2026-07-29T17:0xZ — per-launch timing over the remote tunnel bottoms out at
+    # ~3.7ms regardless of shape and had produced bogus winners; see
+    # bench_kernels.py and TPU_PROBES.log for the methodology note).
     (128, 128, 64): (128, 128),
-    (512, 512, 64): (256, 128),
+    (256, 256, 64): (256, 256),
+    (512, 512, 64): (256, 512),
+    (1024, 1024, 64): (512, 512),
+    (512, 512, 128): (512, 512),
 }
+
+#: measured pallas-vs-XLA verdicts per shape class (same sweep + the END-TO-END
+#: arbiter: BERT-base train step on v5e ran 56.4ms/step with XLA attention vs
+#: 69.8ms with pallas at B=64 S=128 — TPU_PROBES.log 2026-07-29). XLA's fused
+#: attention wins or ties every measured practical shape on v5e; the pallas
+#: kernels remain available via impl="pallas" and carry the tuned blocks above.
+MEASURED_IMPL: Dict[Tuple[int, int, int], str] = {
+    (128, 128, 64): "xla",
+    (256, 256, 64): "xla",
+    (512, 512, 64): "xla",
+    (1024, 1024, 64): "xla",  # sweep margin <1% — a tie broken toward the default
+    (512, 512, 128): "xla",
+}
+
+#: unmeasured shapes follow the measured trend on this hardware
+DEFAULT_TPU_IMPL = "xla"
+
+
+def pick_impl(seq_q: int, seq_k: int, head_dim: int) -> str:
+    """Measured attention backend for a shape class ("xla" or "pallas")."""
+    return MEASURED_IMPL.get((seq_q, seq_k, head_dim), DEFAULT_TPU_IMPL)
 
 #: candidate block edges for the sweep and the fallback ladder
 BLOCK_CANDIDATES: Tuple[int, ...] = (512, 256, 128, 64)
