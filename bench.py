@@ -15,6 +15,7 @@ All logging goes to stderr; stdout carries only the JSON line.
 import json
 import logging
 import os
+import subprocess
 import sys
 import time
 
@@ -22,51 +23,149 @@ logging.basicConfig(stream=sys.stderr)
 for noisy in ("jax", "unionml_tpu"):
     logging.getLogger(noisy).setLevel(logging.WARNING)
 
+#: persistent XLA compilation cache — the B=64 BERT-base compile costs ~132s cold on
+#: the remote v5e tunnel (TPU_PROBES.log round 2); a warmed cache turns the driver's
+#: end-of-round run into a load instead of a compile. Warmed by tools/tpu_window.sh.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+)
+
 #: round-2 v5e-1 measurement (examples/s): BERT-base bf16, batch 32, seq 128, pallas
 #: flash attention, steady-state with device-to-host fetch as the sync barrier
 #: (2026-07-29, TPU_PROBES.log). Later rounds report vs_baseline against it.
 BASELINE_EXAMPLES_PER_S = 770.0
 
-#: seconds before the watchdog declares the accelerator unreachable (a wedged remote-TPU
-#: tunnel hangs jax backend init indefinitely; the driver still needs its JSON line)
-DEVICE_INIT_TIMEOUT_S = float(os.getenv("UNIONML_BENCH_INIT_TIMEOUT", "180"))
+#: hard ceiling on wall-clock before a zero result is emitted no matter what phase
+#: the run is in (probing, init, compile, measure). One global deadline — armed at
+#: process start — guarantees the driver its JSON line at a bounded time; per-phase
+#: watchdogs proved composable into a >500s worst case in review. Sized for the
+#: worst honest path: ~60s lock wait + 2x60s probes + init + ~132s cold compile +
+#: one OOM-fallback recompile + measure.
+TOTAL_BUDGET_S = float(os.getenv("UNIONML_BENCH_TOTAL_BUDGET", "540"))
+
+#: per-attempt timeout for the subprocess init probes and how many to run before
+#: giving up. A wedged tunnel poisons in-process jax backend init unrecoverably, so
+#: reachability is probed in child processes first — each failed child dies cleanly
+#: and the next attempt starts fresh (round-2 failure mode: one in-process init hung
+#: 180s with no retry possible; BENCH_r02.json recorded 0.0).
+PROBE_TIMEOUT_S = float(os.getenv("UNIONML_BENCH_PROBE_TIMEOUT", "60"))
+PROBE_ATTEMPTS = int(os.getenv("UNIONML_BENCH_PROBE_ATTEMPTS", "2"))
+
+#: set by tools/tpu_window.sh: the battery already liveness-checked the tunnel and
+#: holds .tpu_window.lock itself, so its child bench must not probe (wastes tunnel
+#: time) or wait on the lock (its own parent holds it — deadlock-by-design otherwise)
+IN_BATTERY = os.getenv("UNIONML_BENCH_IN_BATTERY", "") == "1"
+
+
+def _acquire_battery_lock(timeout_s: float = 60.0) -> None:
+    """Wait briefly for our own measurement battery to release the tunnel.
+
+    tools/tpu_window.sh holds ``.tpu_window.lock`` for the duration of a battery;
+    when the driver's bench run lands mid-battery, waiting here beats racing the
+    single-client tunnel (round-2 failure mode). Best-effort: proceed after the
+    timeout either way — this process must always emit its JSON line.
+    """
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".tpu_window.lock")
+    try:
+        import fcntl
+
+        deadline = time.monotonic() + timeout_s
+        with open(path, "w") as fh:
+            while time.monotonic() < deadline:
+                try:
+                    fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    fcntl.flock(fh, fcntl.LOCK_UN)
+                    return
+                except OSError:
+                    print("[bench] battery lock held; waiting...", file=sys.stderr)
+                    time.sleep(5.0)
+            print(f"[bench] battery lock still held after {timeout_s:.0f}s; proceeding", file=sys.stderr)
+    except Exception:
+        pass
+
+
+def _wait_for_backend() -> bool:
+    """Probe accelerator init in fresh subprocesses until one succeeds.
+
+    Returns True when a child completed ``jax.devices()`` on a non-CPU backend (the
+    tunnel is live and a subsequent in-process init should succeed quickly), False
+    when every attempt timed out, failed, or silently fell back to CPU. CPU runs and
+    battery children (tunnel already liveness-checked) skip the probe entirely.
+    """
+    from __graft_entry__ import _wants_cpu
+
+    if _wants_cpu() or IN_BATTERY:
+        return True
+    _acquire_battery_lock()
+    code = "import jax; print(jax.devices()[0].platform)"
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=PROBE_TIMEOUT_S,
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode == 0:
+                lines = (proc.stdout or "").strip().splitlines()
+                platform = lines[-1] if lines else "?"
+                if platform == "cpu":
+                    # accelerator plugin absent / silent CPU fallback: retrying can't
+                    # help, and a CPU number must never masquerade as the TPU headline
+                    print(
+                        f"[bench] init probe {attempt}/{PROBE_ATTEMPTS}: backend fell back "
+                        "to CPU on a non-CPU run; accelerator absent.",
+                        file=sys.stderr,
+                    )
+                    return False
+                print(
+                    f"[bench] init probe {attempt}/{PROBE_ATTEMPTS} OK in "
+                    f"{time.monotonic() - t0:.1f}s (platform={platform})",
+                    file=sys.stderr,
+                )
+                return True
+            print(
+                f"[bench] init probe {attempt}/{PROBE_ATTEMPTS} failed rc={proc.returncode}: "
+                f"{(proc.stderr or '').strip()[-300:]}",
+                file=sys.stderr,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"[bench] init probe {attempt}/{PROBE_ATTEMPTS} timed out after "
+                f"{PROBE_TIMEOUT_S:.0f}s (tunnel wedged or down)",
+                file=sys.stderr,
+            )
+        time.sleep(2.0)
+    return False
 
 
 import threading
 
 #: serializes the final stdout line between the main thread and the watchdog so the
-#: "exactly ONE JSON line" contract holds even in the init-finishes-at-deadline race
-_OUTPUT_LOCK = threading.Lock()
+#: "exactly ONE JSON line" contract holds even in the finishes-at-deadline race
+#: (reentrant: the watchdog re-checks completion under the lock, then emits through
+#: the shared zero-result helper which takes it again)
+_OUTPUT_LOCK = threading.RLock()
+
+#: set once the real JSON line has been printed; the watchdog stands down
+_DONE = threading.Event()
 
 
-def _install_device_watchdog():
-    ready = threading.Event()
+def _install_global_watchdog():
+    """One deadline for the whole run, armed before any backend work starts."""
 
     def watchdog():
-        if not ready.wait(DEVICE_INIT_TIMEOUT_S):
+        if not _DONE.wait(TOTAL_BUDGET_S):
             with _OUTPUT_LOCK:
-                if ready.is_set():  # init squeaked in at the deadline: let the run finish
+                if _DONE.is_set():  # result squeaked in at the deadline
                     return
-                print(
-                    f"[bench] accelerator init did not complete within {DEVICE_INIT_TIMEOUT_S}s "
-                    "(remote-TPU tunnel unreachable?); emitting a zero result.",
-                    file=sys.stderr,
+                _emit_zero_and_exit(
+                    f"run did not complete within the {TOTAL_BUDGET_S:.0f}s total budget "
+                    "(wedged tunnel, hung init, or runaway compile)"
                 )
-                print(
-                    json.dumps(
-                        {
-                            "metric": "bert_base_finetune_throughput",
-                            "value": 0.0,
-                            "unit": "examples/s",
-                            "vs_baseline": 0.0,
-                        }
-                    ),
-                    flush=True,
-                )
-                os._exit(1)
 
     threading.Thread(target=watchdog, daemon=True).start()
-    return ready
 
 
 #: peak dense bf16 TFLOP/s per chip for MFU accounting (public spec sheets).
@@ -97,8 +196,30 @@ def _chip_peak_flops():
     return None
 
 
+def _emit_zero_and_exit(reason: str):
+    with _OUTPUT_LOCK:
+        print(f"[bench] {reason}; emitting a zero result.", file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": "bert_base_finetune_throughput",
+                    "value": 0.0,
+                    "unit": "examples/s",
+                    "vs_baseline": 0.0,
+                }
+            ),
+            flush=True,
+        )
+        os._exit(1)
+
+
 def run_bench():
-    ready = _install_device_watchdog()
+    _install_global_watchdog()
+    if not _wait_for_backend():
+        _emit_zero_and_exit(
+            f"accelerator unreachable after {PROBE_ATTEMPTS} subprocess init probes "
+            f"({PROBE_TIMEOUT_S:.0f}s each)"
+        )
 
     from __graft_entry__ import _honor_cpu_request
 
@@ -107,8 +228,6 @@ def run_bench():
     import jax
 
     jax.devices()  # forces backend init — the step that hangs when the tunnel is down
-    with _OUTPUT_LOCK:
-        ready.set()
 
     import jax.numpy as jnp
     import numpy as np
@@ -190,7 +309,10 @@ def run_bench():
 
 
 def main():
-    value, mfu = run_bench()
+    try:
+        value, mfu = run_bench()
+    except BaseException as exc:  # noqa: BLE001 — the JSON-line contract beats a traceback
+        _emit_zero_and_exit(f"benchmark raised {type(exc).__name__}: {exc}")
     vs_baseline = value / BASELINE_EXAMPLES_PER_S if BASELINE_EXAMPLES_PER_S else 1.0
     payload = {
         "metric": "bert_base_finetune_throughput",
@@ -201,6 +323,7 @@ def main():
     if mfu is not None:
         payload["mfu"] = round(mfu, 4)
     with _OUTPUT_LOCK:
+        _DONE.set()
         print(json.dumps(payload))
 
 

@@ -11,9 +11,21 @@ set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date -u +%Y-%m-%dT%H:%M:%SZ)
 
+# Single-client tunnel: only one process may hold the TPU at a time. All of our own
+# hardware use goes through this flock so batteries never overlap each other; the
+# watcher is additionally killed well before round end so nothing of ours holds the
+# tunnel when the driver runs bench.py (round-2 postmortem: our own late probes
+# occupied the tunnel during the driver's 16:43Z run and it recorded 0.0).
+LOCKFILE=.tpu_window.lock
+exec 9>"$LOCKFILE"
+if ! flock -n 9; then
+  echo "$STAMP tpu_window.sh: another battery holds $LOCKFILE; aborting" >> TPU_PROBES.log
+  exit 3  # exit codes: 0 battery ok, 1 bench failed, 2 tunnel not live, 3 lock held
+fi
+
 if ! timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
   echo "$STAMP tpu_window.sh: tunnel not live; aborting" >> TPU_PROBES.log
-  exit 1
+  exit 2
 fi
 echo "$STAMP tpu_window.sh: tunnel LIVE, starting battery" >> TPU_PROBES.log
 
@@ -22,12 +34,27 @@ run() {
   local t0=$(date -u +%H:%M:%SZ)
   if timeout "$tmo" "$@" > "/tmp/tpu_${name}.out" 2> "/tmp/tpu_${name}.err"; then
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: $name OK (started $t0): $(tail -1 /tmp/tpu_${name}.out)" >> TPU_PROBES.log
+    return 0
   else
-    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: $name FAILED rc=$? (started $t0); see /tmp/tpu_${name}.err" >> TPU_PROBES.log
+    local rc=$?
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: $name FAILED rc=$rc (started $t0); see /tmp/tpu_${name}.err" >> TPU_PROBES.log
+    return "$rc"
   fi
 }
 
-run bench 420 python bench.py
+# bench.py is the battery's reason to exist (the driver's headline artifact). If it
+# fails the tunnel is almost certainly wedged — abort instead of burning the kernel
+# and serving timeouts against a dead tunnel, and exit nonzero so the watcher waits
+# for the next window. UNIONML_BENCH_IN_BATTERY tells the child to skip its own
+# probes (tunnel already liveness-checked above) and its battery-lock wait (we hold
+# that lock).
+export UNIONML_BENCH_IN_BATTERY=1
+export UNIONML_BENCH_TOTAL_BUDGET=560  # under the 600s shell timeout: the zero line beats SIGKILL
+if ! run bench 600 python bench.py; then
+  echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: bench failed; aborting battery (tunnel likely wedged)" >> TPU_PROBES.log
+  exit 1
+fi
 run kernels 900 python bench_kernels.py
 run serving 420 python bench_serving.py --bert-base
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log
+exit 0
