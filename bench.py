@@ -227,6 +227,14 @@ def run_bench():
 
     import jax
 
+    try:
+        # under the site TPU shim jax imported at interpreter start and captured the
+        # env before this module set JAX_COMPILATION_CACHE_DIR; repoint the config
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:  # noqa: BLE001 - cache is an optimization, never a failure
+        pass
+
     jax.devices()  # forces backend init — the step that hangs when the tunnel is down
 
     import jax.numpy as jnp

@@ -1,0 +1,165 @@
+"""MFU experiment sweep: measure throughput variants of the headline BERT-base step.
+
+Run on real TPU during a tunnel window (tools/tpu_window.sh). Each variant times the
+same fine-tune step with one knob changed; MFU_SWEEP.json records the whole sweep
+(every variant's result or error, with a timestamp) so winners can be promoted into
+bench.py / model defaults with measured justification (VERDICT round-2 item 2:
+30% -> 45% MFU).
+
+Variants:
+- batch ladder: B=64 (headline), 128, 256 — MXU tiles grow with batch
+- gelu tanh-approximate vs exact erf (VPU-bound candidate)
+- no attention mask (quantifies the all-ones-mask overhead the headline pays)
+- metrics-light (no grad_norm metric — tests the XLA-CSE-merges-the-norms assumption)
+- S=512 at B=16 (same token count as B=64/S=128; long-seq regime)
+
+CPU smoke: runs the tiny config so the harness itself stays testable.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+)
+
+#: whole-sweep wall-clock budget; variants still pending when it expires are skipped
+#: (a wedged tunnel must not hold the battery hostage)
+TOTAL_BUDGET_S = float(os.getenv("UNIONML_MFU_BUDGET", "600"))
+
+
+def _measure(step, state, batch, batch_size, warmup=3, steps=15):
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # device-to-host fetch = real barrier (utils.hard_sync note)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+    return steps * batch_size / elapsed
+
+
+def run_sweep():
+    from __graft_entry__ import _honor_cpu_request
+
+    _honor_cpu_request()
+
+    import jax
+
+    try:
+        # the site shim imports jax before this module's env line; repoint the config
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:  # noqa: BLE001
+        pass
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _chip_peak_flops
+    from unionml_tpu.models import (
+        BertConfig,
+        BertForSequenceClassification,
+        create_train_state,
+        init_params,
+    )
+    from unionml_tpu.models.training import bert_flops_per_token, make_classifier_train_step
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    peak = _chip_peak_flops() if on_accel else None
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+
+    if on_accel:
+        base = dict(dtype=jnp.bfloat16)
+        variants = [
+            ("b64_headline", dict(batch=64, seq=128)),
+            ("b128", dict(batch=128, seq=128)),
+            ("b256", dict(batch=256, seq=128)),
+            ("b64_gelu_tanh", dict(batch=64, seq=128, config=dict(gelu_approximate=True))),
+            ("b64_nomask", dict(batch=64, seq=128, mask=False)),
+            ("b64_no_gradnorm_metric", dict(batch=64, seq=128, light_metrics=True)),
+            ("s512_b16", dict(batch=16, seq=512)),
+        ]
+        config_cls = BertConfig.base
+    else:  # CPU smoke of the harness itself
+        base = dict(dtype=jnp.float32, attention_impl="xla")
+        variants = [
+            ("b8_smoke", dict(batch=8, seq=128)),
+            ("b8_gelu_tanh", dict(batch=8, seq=128, config=dict(gelu_approximate=True))),
+        ]
+        config_cls = BertConfig.tiny
+
+    rng = np.random.default_rng(0)
+    results = []
+    for name, spec in variants:
+        if time.monotonic() > deadline:
+            print(f"[mfu] budget exhausted; skipping {name} onward", file=sys.stderr)
+            break
+        try:
+            cfg_overrides = dict(base)
+            cfg_overrides.update(spec.get("config", {}))
+            config = config_cls(**cfg_overrides)
+            batch_size, seq_len = spec["batch"], spec["seq"]
+            model = BertForSequenceClassification(config)
+            variables = init_params(config, seq_len=seq_len)
+            state = create_train_state(
+                model, variables, learning_rate=2e-5, warmup_steps=10, total_steps=1000
+            )
+            step = make_classifier_train_step(
+                input_signature=("input_ids", "attention_mask") if spec.get("mask", True) else ("input_ids",),
+                light_metrics=spec.get("light_metrics", False),
+            )
+            batch = {
+                "input_ids": jnp.asarray(
+                    rng.integers(0, config.vocab_size, size=(batch_size, seq_len)), dtype=jnp.int32
+                ),
+                "labels": jnp.asarray(
+                    rng.integers(0, config.num_labels, size=(batch_size,)), dtype=jnp.int32
+                ),
+            }
+            if spec.get("mask", True):
+                batch["attention_mask"] = jnp.ones((batch_size, seq_len), dtype=jnp.int32)
+            t_compile = time.monotonic()
+            examples_per_s = _measure(step, state, batch, batch_size)
+            tokens_per_s = examples_per_s * seq_len
+            mfu = (
+                tokens_per_s * bert_flops_per_token(config) / peak if peak else None
+            )
+            entry = {
+                "variant": name,
+                "examples_per_s": round(examples_per_s, 1),
+                "tokens_per_s": round(tokens_per_s),
+                "batch": batch_size,
+                "seq": seq_len,
+                "wall_s": round(time.monotonic() - t_compile, 1),
+            }
+            if mfu is not None:
+                entry["mfu"] = round(mfu, 4)
+            results.append(entry)
+            print(f"[mfu] {json.dumps(entry)}", file=sys.stderr)
+        except Exception as exc:
+            print(f"[mfu] {name} failed: {type(exc).__name__}: {exc}", file=sys.stderr)
+            results.append({"variant": name, "error": f"{type(exc).__name__}: {exc}"})
+    return results
+
+
+def main():
+    results = run_sweep()
+    payload = {
+        "sweep": "bert_base_train_step_variants",
+        "stamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "results": results,
+    }
+    # write on any accelerator run — including all-errors sweeps, whose error
+    # entries + stamp must replace stale numbers rather than impersonate them
+    if any("mfu" in r or "error" in r for r in results):
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "MFU_SWEEP.json"), "w") as fh:
+            json.dump(payload, fh, indent=2)
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,7 +12,9 @@ setup(
     description="TPU-native ML microservice framework: train, serve, and deploy compiled models",
     packages=find_packages(include=["unionml_tpu", "unionml_tpu.*"]),
     include_package_data=True,
-    package_data={"unionml_tpu": ["templates/**/*"]},
+    # glob semantics skip dotfiles: the scaffold .gitignore files need their own
+    # explicit pattern or wheels ship templates without them
+    package_data={"unionml_tpu": ["templates/**/*", "templates/*/.gitignore"]},
     python_requires=">=3.10",
     install_requires=[
         "jax",

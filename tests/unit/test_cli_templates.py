@@ -36,6 +36,16 @@ def test_render_template_compiles(template, tmp_path):
     py_compile.compile(str(app_py), doraise=True)
     assert (target / ".git").exists()  # app versioning needs a git repo
 
+    # scaffolds are complete, deployable projects (reference parity:
+    # templates/basic/{{cookiecutter.app_name}}/{Dockerfile,requirements.txt,...})
+    for aux in ("Dockerfile", "requirements.txt", ".gitignore", "README.md"):
+        assert (target / aux).exists(), f"{template} missing {aux}"
+    assert "{{app_name}}" not in (target / "Dockerfile").read_text()
+    reqs = (target / "requirements.txt").read_text().splitlines()
+    assert "unionml-tpu" in [r.strip() for r in reqs if r.strip()]
+    sample = json.loads((target / "data" / "sample_features.json").read_text())
+    assert isinstance(sample, dict) and ("features" in sample or "inputs" in sample)
+
 
 def test_render_template_validations(tmp_path):
     with pytest.raises(ValueError, match="identifier"):

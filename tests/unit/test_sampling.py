@@ -171,3 +171,31 @@ def test_oneshot_generate_top_k_one_is_greedy(gpt):
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
     with pytest.raises(ValueError, match="top_p"):
         generate(model, variables, ids, 2, top_p=2.0)
+
+
+def test_top_p_tie_outside_nucleus_excluded():
+    """A token outside the nucleus whose probability exactly ties the boundary
+    must be masked (ADVICE round-2: the unsorted-space threshold kept it)."""
+    # two equal-prob tokens: top_p small enough that ONE covers the mass
+    logits = jnp.log(jnp.asarray([[0.4, 0.4, 0.2]]))
+    out = apply_top_p(logits, jnp.asarray([0.3]))
+    kept = np.isfinite(np.asarray(out))[0]
+    assert kept.sum() == 1  # exactly one of the tied pair survives
+
+
+def test_validate_sampling_rejects_non_integral_top_k():
+    from unionml_tpu.ops.sampling import validate_sampling
+
+    with pytest.raises(ValueError):
+        validate_sampling(top_k=1.9)
+    with pytest.raises(ValueError):
+        validate_sampling(top_k=True)
+    with pytest.raises(ValueError):
+        validate_sampling(top_k="5")
+    with pytest.raises(ValueError):
+        validate_sampling(temperature=True)
+    with pytest.raises(ValueError):
+        validate_sampling(top_p=True)
+    # integral floats and numpy ints stay accepted
+    assert validate_sampling(top_k=2.0)[1] == 2
+    assert validate_sampling(top_k=np.int64(3))[1] == 3

@@ -133,3 +133,25 @@ def test_validation_errors(target, draft):
     s_vars = init_params(small, seq_len=8)
     with pytest.raises(ValueError, match="vocab"):
         speculative_generate(t_model, t_vars, s_model, s_vars, ok, 4)
+
+
+def test_compiled_fns_cached_across_calls(target, draft):
+    """Repeated calls with one engine config reuse the compiled propose/verify
+    (ADVICE round-2: per-call @jax.jit closures recompiled both programs every
+    generate call, making serving pay seconds of XLA compile per request)."""
+    from unionml_tpu.models.speculative import _compiled_round_fns
+
+    t_model, t_vars = target
+    d_model, d_vars = draft
+    prompt = jnp.asarray([[2, 7, 1]], dtype=jnp.int32)
+
+    _compiled_round_fns.cache_clear()
+    speculative_generate(t_model, t_vars, d_model, d_vars, prompt, 6, gamma=2)
+    info = _compiled_round_fns.cache_info()
+    assert info.misses == 1
+
+    speculative_generate(t_model, t_vars, d_model, d_vars, prompt, 6, gamma=2)
+    info = _compiled_round_fns.cache_info()
+    # same engine config: factory hit — the jit wrappers (and their compiled
+    # executables) are the same objects, so no re-trace/recompile can occur
+    assert info.misses == 1 and info.hits >= 1

@@ -54,6 +54,7 @@ if ! run bench 600 python bench.py; then
   echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: bench failed; aborting battery (tunnel likely wedged)" >> TPU_PROBES.log
   exit 1
 fi
+run mfu 700 python bench_mfu.py
 run kernels 900 python bench_kernels.py
 run serving 420 python bench_serving.py --bert-base
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log

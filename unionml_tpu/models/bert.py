@@ -47,6 +47,10 @@ class BertConfig:
     #: mesh carrying a "sequence" axis for ring/ulysses attention
     sp_mesh: Any = None
     remat: bool = False
+    #: tanh-approximate GELU trades exact erf (VPU-expensive) for the cheaper tanh
+    #: polynomial — numerically within ~1e-3 of exact, a candidate MFU lever whose
+    #: value is measured on hardware by bench_mfu.py before changing any default
+    gelu_approximate: bool = False
 
     @classmethod
     def base(cls, **overrides) -> "BertConfig":
@@ -113,7 +117,7 @@ class BertMlp(nn.Module):
     def __call__(self, hidden, deterministic: bool):
         cfg = self.config
         up = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="intermediate")(hidden)
-        up = nn.gelu(up, approximate=False)
+        up = nn.gelu(up, approximate=cfg.gelu_approximate)
         down = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(up)
         down = nn.Dropout(cfg.hidden_dropout)(down, deterministic=deterministic)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="output_norm")(

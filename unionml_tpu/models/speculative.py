@@ -31,6 +31,7 @@ machinery at all; this extends the TPU build's GPT family
 (``models/gpt.py::generate``) with a lossless latency optimization.
 """
 
+import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -48,54 +49,16 @@ def _prefill(model, variables, prompt_ids, max_len):
     return cache, logits[:, -1, :]
 
 
-def speculative_generate(
-    target: Any,
-    target_variables: Any,
-    draft: Any,
-    draft_variables: Any,
-    prompt_ids: jax.Array,
-    max_new_tokens: int,
-    *,
-    gamma: int = 4,
-    temperature: float = 0.0,
-    rng: Optional[jax.Array] = None,
-    return_stats: bool = False,
-) -> Any:
-    """Decode ``max_new_tokens`` from ``target`` using ``draft`` speculation.
+@functools.lru_cache(maxsize=16)
+def _compiled_round_fns(target, draft, gamma: int, temperature: float):
+    """Compiled (propose, verify, select) for one engine configuration.
 
-    :param target: the model whose output distribution is authoritative
-        (:class:`~unionml_tpu.models.gpt.GPTLMHeadModel` or compatible).
-    :param draft: a cheaper model sharing the target's vocabulary.
-    :param prompt_ids: ``(1, prompt_len)`` int32 — batch 1 (rows would accept
-        different prefix lengths and diverge positionally; batched speculation
-        needs per-row chunk positions the cache layout doesn't support yet).
-    :param gamma: proposal tokens per round; each round costs one draft scan of
-        ``gamma`` steps plus ONE target forward over ``gamma+1`` tokens and
-        advances 1..gamma+1 tokens.
-    :param return_stats: also return ``{"rounds", "proposed", "accepted",
-        "acceptance_rate"}`` (bonus/correction tokens are not counted as
-        accepted proposals).
-    :returns: ``(1, prompt_len + max_new_tokens)`` ids — same contract as
-        :func:`unionml_tpu.models.gpt.generate` — or ``(ids, stats)``.
+    Cached at module level so repeated/serving calls reuse the XLA executables:
+    defining these as per-call closures re-traced AND recompiled both programs on
+    every generate call (ADVICE round-2). flax modules are frozen dataclasses
+    (hashable, parameter-free metadata), so they key the cache directly; variables
+    stay call arguments.
     """
-    if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
-        raise ValueError(f"speculative_generate expects (1, prompt_len) ids; got {prompt_ids.shape}")
-    if gamma < 1:
-        raise ValueError("gamma must be >= 1")
-    if target.config.vocab_size != draft.config.vocab_size:
-        raise ValueError(
-            f"draft vocab ({draft.config.vocab_size}) must match target ({target.config.vocab_size})"
-        )
-    prompt_len = prompt_ids.shape[1]
-    # speculation overshoots by up to gamma rejected columns; reserve the slack
-    max_len = prompt_len + max_new_tokens + gamma + 1
-    for cfg, name in ((target.config, "target"), (draft.config, "draft")):
-        if max_len > cfg.max_position_embeddings:
-            raise ValueError(
-                f"prompt + max_new_tokens + gamma ({max_len}) exceeds the {name}'s "
-                f"max_position_embeddings ({cfg.max_position_embeddings})"
-            )
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
     greedy = temperature <= 0.0
 
     def select(logits, key):
@@ -168,6 +131,59 @@ def speculative_generate(
         emissions = jnp.concatenate([emitted, jnp.zeros((1,), jnp.int32)])
         emissions = emissions.at[a].set(closer)
         return a, emissions, cache, key
+
+    return propose, verify, select
+
+
+def speculative_generate(
+    target: Any,
+    target_variables: Any,
+    draft: Any,
+    draft_variables: Any,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    return_stats: bool = False,
+) -> Any:
+    """Decode ``max_new_tokens`` from ``target`` using ``draft`` speculation.
+
+    :param target: the model whose output distribution is authoritative
+        (:class:`~unionml_tpu.models.gpt.GPTLMHeadModel` or compatible).
+    :param draft: a cheaper model sharing the target's vocabulary.
+    :param prompt_ids: ``(1, prompt_len)`` int32 — batch 1 (rows would accept
+        different prefix lengths and diverge positionally; batched speculation
+        needs per-row chunk positions the cache layout doesn't support yet).
+    :param gamma: proposal tokens per round; each round costs one draft scan of
+        ``gamma`` steps plus ONE target forward over ``gamma+1`` tokens and
+        advances 1..gamma+1 tokens.
+    :param return_stats: also return ``{"rounds", "proposed", "accepted",
+        "acceptance_rate"}`` (bonus/correction tokens are not counted as
+        accepted proposals).
+    :returns: ``(1, prompt_len + max_new_tokens)`` ids — same contract as
+        :func:`unionml_tpu.models.gpt.generate` — or ``(ids, stats)``.
+    """
+    if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
+        raise ValueError(f"speculative_generate expects (1, prompt_len) ids; got {prompt_ids.shape}")
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+    if target.config.vocab_size != draft.config.vocab_size:
+        raise ValueError(
+            f"draft vocab ({draft.config.vocab_size}) must match target ({target.config.vocab_size})"
+        )
+    prompt_len = prompt_ids.shape[1]
+    # speculation overshoots by up to gamma rejected columns; reserve the slack
+    max_len = prompt_len + max_new_tokens + gamma + 1
+    for cfg, name in ((target.config, "target"), (draft.config, "draft")):
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt + max_new_tokens + gamma ({max_len}) exceeds the {name}'s "
+                f"max_position_embeddings ({cfg.max_position_embeddings})"
+            )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    propose, verify, select = _compiled_round_fns(target, draft, gamma, float(temperature))
 
     # --- prefill both models, emit the first token from the target alone
     target_cache, t_logits = _prefill(target, target_variables, prompt_ids, max_len)

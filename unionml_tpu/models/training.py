@@ -73,12 +73,16 @@ def make_classifier_train_step(
     mesh: Optional[Mesh] = None,
     param_spec: Any = None,
     input_signature: Tuple[str, ...] = ("inputs",),
+    light_metrics: bool = False,
 ) -> Callable:
     """Build the compiled train step ``(state, batch) -> (state, metrics)``.
 
     ``batch`` is a dict with ``input_signature`` keys + ``"labels"``. With a mesh, the
     batch is sharded over the data axis and the state laid out by ``param_spec``
     (replicated when None); XLA inserts the grad all-reduce over ICI.
+    ``light_metrics=True`` drops the ``grad_norm`` metric — in principle XLA CSEs it
+    against the identical norm inside ``clip_by_global_norm``, and bench_mfu.py
+    measures whether that holds on real hardware.
     """
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
@@ -95,7 +99,9 @@ def make_classifier_train_step(
 
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
         new_state = state.apply_gradients(grads=grads)
-        metrics = {"loss": loss, "accuracy": acc, "grad_norm": optax.global_norm(grads)}
+        metrics = {"loss": loss, "accuracy": acc}
+        if not light_metrics:
+            metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
     if mesh is None:

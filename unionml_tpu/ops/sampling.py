@@ -35,12 +35,25 @@ def validate_sampling(temperature=None, top_k=0, top_p=1.0):
     :raises ValueError: temperature < 0, top_k < 0, or top_p outside ``(0, 1]``.
     """
     if temperature is not None:
+        if isinstance(temperature, bool):
+            raise ValueError("temperature must be a number")
         temperature = float(temperature)
         if temperature < 0.0:
             raise ValueError("temperature must be >= 0")
+    # booleans are ints in Python and int() truncates floats — both would turn a
+    # malformed top_k into a silently different request instead of a 422
+    if isinstance(top_k, bool):
+        raise ValueError("top_k must be an integer")
+    try:
+        if int(top_k) != top_k:
+            raise ValueError(f"top_k must be an integer, got {top_k!r}")
+    except TypeError:
+        raise ValueError(f"top_k must be an integer, got {top_k!r}")
     top_k = int(top_k)
     if top_k < 0:
         raise ValueError("top_k must be >= 0")
+    if isinstance(top_p, bool):
+        raise ValueError("top_p must be a number")
     top_p = float(top_p)
     if not 0.0 < top_p <= 1.0:
         raise ValueError("top_p must be in (0, 1]")
@@ -72,13 +85,17 @@ def apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
     :param top_p: ``(batch,)`` float in ``(0, 1]``.
     """
     probs = jax.nn.softmax(logits, axis=-1)
-    sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
+    sort_idx = jnp.argsort(-probs, axis=-1)  # descending, ties broken by index
+    sorted_probs = jnp.take_along_axis(probs, sort_idx, axis=-1)
     cumulative = jnp.cumsum(sorted_probs, axis=-1)
     # a sorted position is kept while the mass BEFORE it is < top_p, so the
     # prefix always includes position 0 and stops once mass is covered
     keep_sorted = (cumulative - sorted_probs) < top_p[:, None]
-    min_kept = jnp.min(jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1, keepdims=True)
-    keep = probs >= min_kept
+    # scatter the sorted keep mask back through the sort permutation (HF-style):
+    # a threshold comparison in unsorted space would also keep tokens OUTSIDE the
+    # nucleus whose probability exactly ties the boundary (ADVICE round-2)
+    inv_idx = jnp.argsort(sort_idx, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv_idx, axis=-1)
     keep = jnp.where((top_p < 1.0)[:, None], keep, True)
     return jnp.where(keep, logits, -jnp.inf)
 
