@@ -74,6 +74,7 @@ class DecoderBlock(nn.Module):
         position,
         deterministic: bool,
         pad_offsets: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
     ):
         """Full-sequence (cache=None) or single-token incremental (cache given) step.
 
@@ -83,7 +84,9 @@ class DecoderBlock(nn.Module):
         int vector (continuous batching: each row at its OWN step, writing its own
         cache column; requires seq == 1). ``pad_offsets`` is a (batch,) count of
         LEFT-pad tokens per row (ragged-prompt batching): key positions below a
-        row's offset are masked for that row. Returns (hidden, new_cache).
+        row's offset are masked for that row. ``segment_ids`` (batch, seq) selects
+        packed-sequence training (cache=None only): causal attention additionally
+        confined to same-segment tokens. Returns (hidden, new_cache).
         """
         cfg = self.config
         batch, seq, _ = hidden.shape
@@ -98,7 +101,16 @@ class DecoderBlock(nn.Module):
             return (k_positions[None, :] >= pad_offsets[:, None])[:, None, None, :]
 
         if cache is None:
-            if cfg.attention_impl in ("ring", "ulysses"):
+            if segment_ids is not None:
+                if pad_offsets is not None or cfg.attention_impl in ("ring", "ulysses"):
+                    raise ValueError(
+                        "segment_ids (packed training) composes with neither pad_offsets "
+                        "(left-padded ragged batches) nor sequence-parallel attention"
+                    )
+                context = attention(
+                    q, k, v, segment_ids=segment_ids, causal=True, impl=cfg.attention_impl
+                )
+            elif cfg.attention_impl in ("ring", "ulysses"):
                 # sequence-parallel long-context training: activations shard over
                 # the mesh's "sequence" axis; causal masking is handled inside
                 if pad_offsets is not None:
@@ -206,21 +218,41 @@ class GPTLMHeadModel(nn.Module):
         position: Optional[jax.Array] = None,
         deterministic: bool = True,
         pad_offsets: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
     ):
         """``pad_offsets`` (batch,) enables ragged-prompt batching: rows are LEFT-
         padded, each row's position embeddings start at its first real token, and
         attention never sees a row's pad region. Requires ``deterministic=True`` on
         sparse configs: capacity-bounded expert dispatch has no row isolation (pad
-        tokens would compete for expert capacity slots against real tokens)."""
+        tokens would compete for expert capacity slots against real tokens).
+
+        ``segment_ids`` (batch, seq) enables PACKED training (cache=None): several
+        short sequences share a row (t5x convention: 0 = padding, positive ids =
+        segments), attention is confined to same-segment tokens (flash-kernel
+        blockwise masking — no dense (seq, seq) mask), and position embeddings
+        restart at each segment start. See :func:`unionml_tpu.ops.packing.pack_sequences`.
+        """
         cfg = self.config
         if pad_offsets is not None and cfg.moe_every > 0 and not deterministic:
             raise ValueError(
                 "pad_offsets with a MoE config requires deterministic=True: "
                 "capacity-bounded expert dispatch lets pad tokens evict real tokens."
             )
+        if segment_ids is not None and cache is not None:
+            raise ValueError("segment_ids is a packed-TRAINING feature; decode caches are unpacked")
         batch, seq = input_ids.shape
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="wte")
-        if cache is None:
+        if segment_ids is not None:
+            # positions restart at each segment boundary: subtract the running
+            # index of the latest boundary (cummax of boundary positions)
+            idx = jnp.arange(seq, dtype=jnp.int32)[None, :]
+            ids = segment_ids.astype(jnp.int32)
+            change = jnp.concatenate(
+                [jnp.ones((batch, 1), bool), ids[:, 1:] != ids[:, :-1]], axis=1
+            )
+            seg_start = jax.lax.cummax(jnp.where(change, idx, 0), axis=1)
+            positions = idx - seg_start
+        elif cache is None:
             positions = jnp.arange(seq)[None, :]
         elif not isinstance(position, int) and jnp.ndim(position) == 1:
             # per-row decode positions (continuous batching)
@@ -242,7 +274,7 @@ class GPTLMHeadModel(nn.Module):
             layer_cache = None if cache is None else cache[f"layer_{i}"]
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
             hidden, layer_cache = DecoderBlock(cfg, use_moe=use_moe, name=f"layer_{i}")(
-                hidden, layer_cache, position, deterministic, pad_offsets
+                hidden, layer_cache, position, deterministic, pad_offsets, segment_ids
             )
             if layer_cache is not None:
                 new_cache[f"layer_{i}"] = layer_cache
@@ -366,13 +398,27 @@ def init_params(config: GPTConfig, rng: Optional[jax.Array] = None, seq_len: int
     return model.init({"params": rng}, jnp.zeros((1, seq_len), dtype=jnp.int32), deterministic=True)
 
 
-def lm_loss(logits: jax.Array, input_ids: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
-    """Next-token cross-entropy: logits at t predict input_ids at t+1 (padding masked)."""
+def lm_loss(
+    logits: jax.Array,
+    input_ids: jax.Array,
+    mask: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Next-token cross-entropy: logits at t predict input_ids at t+1 (padding masked).
+
+    With ``segment_ids`` (packed batches), cross-segment transitions are masked
+    too: the last token of one packed sequence must not be trained to predict the
+    first token of the next.
+    """
     from unionml_tpu.ops.losses import cross_entropy_with_integer_labels
 
     shifted_logits = logits[:, :-1, :]
     targets = input_ids[:, 1:]
     weights = None if mask is None else mask[:, 1:]
+    if segment_ids is not None:
+        same_segment = (segment_ids[:, 1:] == segment_ids[:, :-1]) & (segment_ids[:, 1:] > 0)
+        seg_weights = same_segment.astype(shifted_logits.dtype)
+        weights = seg_weights if weights is None else weights * seg_weights
     return cross_entropy_with_integer_labels(shifted_logits, targets, weights)
 
 

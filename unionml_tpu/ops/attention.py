@@ -29,6 +29,19 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def segment_mask(segment_ids: jax.Array) -> jax.Array:
+    """(batch, seq) packed segment ids -> (batch, 1, seq, seq) attention mask.
+
+    Convention (t5x/flax): ``0`` marks padding, positive ints mark segments; a
+    query attends a key iff they carry the same positive id. This dense mask is
+    what packing costs on the XLA path — O(seq^2) HBM per row — and what the
+    pallas kernel's blockwise comparison avoids.
+    """
+    same = segment_ids[:, :, None] == segment_ids[:, None, :]
+    valid = same & (segment_ids > 0)[:, None, :] & (segment_ids > 0)[:, :, None]
+    return valid[:, None, :, :]
+
+
 def xla_attention(
     q: jax.Array,
     k: jax.Array,
@@ -36,6 +49,7 @@ def xla_attention(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference attention; XLA fuses the softmax chain. Used as fallback + backward."""
     *_, seq_q, head_dim = q.shape
@@ -45,9 +59,15 @@ def xla_attention(
     if causal:
         causal_mask = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool))
         logits = jnp.where(causal_mask[None, None], logits, _NEG_INF)
+    if segment_ids is not None:
+        logits = jnp.where(segment_mask(segment_ids), logits, _NEG_INF)
     if mask is not None:
         logits = jnp.where(mask, logits, _NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
+    # fully-masked rows (padding in a packed batch) softmax to uniform garbage;
+    # zero them so packed outputs match the per-sequence reference exactly
+    if segment_ids is not None:
+        weights = jnp.where((segment_ids > 0)[:, None, :, None], weights, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
 
 
@@ -56,14 +76,13 @@ def _flash_kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    lse_ref=None,
-    *,
+    *rest,
     block_k: int,
     seq_k: int,
     causal: bool,
     sm_scale: float,
     block_q: int,
+    packed: bool = False,
 ):
     """One (batch*head, q_block) program: stream KV blocks with an online softmax.
 
@@ -72,10 +91,23 @@ def _flash_kernel(
     so it is passed unblocked and indexed by the grid's batch*head coordinate);
     K positions >= kv_len contribute nothing. When pallas passes a second output
     ref (``lse_ref``), the per-row logsumexp is written as the backward residual.
+
+    ``packed`` prepends two extra input refs carrying packed segment ids in
+    Mosaic-friendly layouts — (1, block_q, 1) and (1, 1, seq_k) blocks of the
+    (batch, seq, 1) / (batch, 1, seq) id arrays — adding the blockwise
+    same-segment constraint that packing needs WITHOUT a dense (seq, seq) mask.
     """
+    if packed:
+        seg_q_ref, seg_k_ref, o_ref, *maybe_lse = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        o_ref, *maybe_lse = rest
+    lse_ref = maybe_lse[0] if maybe_lse else None
+
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, head_dim)
     q_index = pl.program_id(1)
     kv_len = kv_len_ref[pl.program_id(0)]
+    seg_q = None if seg_q_ref is None else seg_q_ref[0].reshape(block_q, 1)
 
     acc = jnp.zeros((block_q, q.shape[-1]), dtype=jnp.float32)
     row_max = jnp.full((block_q, 1), _NEG_INF, dtype=jnp.float32)
@@ -94,6 +126,9 @@ def _flash_kernel(
 
         k_pos = k_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         valid = k_pos < kv_len
+        if seg_q is not None:
+            seg_k = seg_k_ref[0, :, pl.ds(k_idx * block_k, block_k)]  # (1, block_k)
+            valid = jnp.logical_and(valid, jnp.logical_and(seg_q == seg_k, seg_q > 0))
         if causal:
             q_pos = q_index * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
             valid = jnp.logical_and(valid, q_pos >= k_pos)
@@ -113,6 +148,8 @@ def _flash_kernel(
     if causal:
         last_block = jnp.minimum(last_block, pl.cdiv((q_index + 1) * block_q, block_k))
     acc, row_max, row_sum = jax.lax.fori_loop(0, last_block, body, (acc, row_max, row_sum))
+    # row_sum == 0 (fully-masked row: padding in a packed batch) divides to 0, which
+    # matches the zeroed-row convention of the XLA reference and the ring kernel
     o_ref[0] = (acc / jnp.maximum(row_sum, 1e-30)).astype(o_ref.dtype)
     if lse_ref is not None:
         # logsumexp of the (scaled, masked) scores — the residual the backward needs
@@ -126,6 +163,21 @@ def _tile_aligned(seq_q: int, seq_k: int, head_dim: int, block_q: int, block_k: 
     return not (seq_q % block_q or seq_k % block_k or head_dim % 64)
 
 
+def _segment_arrays(segment_ids: jax.Array, seq_q: int, seq_k: int):
+    """Packed ids -> the kernels' Mosaic-friendly operands.
+
+    Returns ``(seg_q3, seg_k3, kv_lens)``: (batch, seq_q, 1) and (batch, 1, seq_k)
+    int32 views (the trailing/leading singleton keeps blocks on the proven
+    (block, 1)/(1, block) tilings) plus the per-row valid length — packing keeps
+    padding as a zero-id suffix, so the block-skip bound stays exact.
+    """
+    ids = segment_ids.astype(jnp.int32)
+    seg_q3 = ids[:, :seq_q, None]
+    seg_k3 = ids[:, None, :seq_k]
+    kv_lens = jnp.sum((ids[:, :seq_k] > 0).astype(jnp.int32), axis=-1)
+    return seg_q3, seg_k3, kv_lens
+
+
 def _flash_forward(
     q: jax.Array,
     k: jax.Array,
@@ -137,19 +189,25 @@ def _flash_forward(
     block_k: int,
     interpret: bool,
     return_residuals: bool = False,
+    segment_ids: Optional[jax.Array] = None,
 ):
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[-2]
 
     if not _tile_aligned(seq_q, seq_k, head_dim, block_q, block_k):
         mask = _kv_lens_to_mask(kv_lens, seq_k) if kv_lens is not None else None
-        out = xla_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+        out = xla_attention(
+            q, k, v, mask=mask, causal=causal, sm_scale=sm_scale, segment_ids=segment_ids
+        )
         return (out, None) if return_residuals else out
 
     bh = batch * heads
     q3 = q.reshape(bh, seq_q, head_dim)
     k3 = k.reshape(bh, seq_k, head_dim)
     v3 = v.reshape(bh, seq_k, head_dim)
+    packed = segment_ids is not None
+    if packed:
+        seg_q3, seg_k3, kv_lens = _segment_arrays(segment_ids, seq_q, seq_k)
     if kv_lens is None:
         kv_lens = jnp.full((batch,), seq_k, dtype=jnp.int32)
     kv_lens_bh = jnp.repeat(kv_lens.astype(jnp.int32), heads)
@@ -161,7 +219,20 @@ def _flash_forward(
         causal=causal,
         sm_scale=sm_scale,
         block_q=block_q,
+        packed=packed,
     )
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # whole kv_lens vector, unblocked
+        pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+    ]
+    operands = [kv_lens_bh, q3, k3, v3]
+    if packed:
+        # segment ids are per-batch-row; the index map folds the head axis away
+        in_specs.append(pl.BlockSpec((1, block_q, 1), lambda b, i: (b // heads, i, 0)))
+        in_specs.append(pl.BlockSpec((1, 1, seq_k), lambda b, i: (b // heads, 0, 0)))
+        operands.extend([seg_q3, seg_k3])
     out_shape = [jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))]
     if return_residuals:
@@ -172,12 +243,7 @@ def _flash_forward(
     result = pl.pallas_call(
         kernel,
         grid=(bh, seq_q // block_q),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole kv_lens vector, unblocked
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, head_dim), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs if return_residuals else out_specs[0],
         out_shape=out_shape if return_residuals else out_shape[0],
         cost_estimate=pl.CostEstimate(
@@ -186,7 +252,7 @@ def _flash_forward(
             transcendentals=bh * seq_q * seq_k,
         ),
         interpret=interpret,
-    )(kv_lens_bh, q3, k3, v3)
+    )(*operands)
     if return_residuals:
         out, lse = result
         return out.reshape(batch, heads, seq_q, head_dim), lse.reshape(batch, heads, seq_q)
@@ -207,21 +273,27 @@ def _bwd_dq_kernel(
     do_ref,
     lse_ref,
     delta_ref,
-    dq_ref,
-    *,
+    *rest,
     block_k: int,
     seq_k: int,
     causal: bool,
     sm_scale: float,
     block_q: int,
+    packed: bool = False,
 ):
     """dQ for one (batch*head, q_block): stream KV blocks, recompute probabilities."""
+    if packed:
+        seg_q_ref, seg_k_ref, dq_ref = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        (dq_ref,) = rest
     qs = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d); scores are pre-scaled
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0].reshape(block_q, 1)
     delta = delta_ref[0].reshape(block_q, 1)
     q_index = pl.program_id(1)
     kv_len = kv_len_ref[pl.program_id(0)]
+    seg_q = None if seg_q_ref is None else seg_q_ref[0].reshape(block_q, 1)
 
     dq = jnp.zeros((block_q, qs.shape[-1]), dtype=jnp.float32)
     num_k_blocks = seq_k // block_k
@@ -234,6 +306,9 @@ def _bwd_dq_kernel(
         )
         k_pos = k_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         valid = k_pos < kv_len
+        if seg_q is not None:
+            seg_k = seg_k_ref[0, :, pl.ds(k_idx * block_k, block_k)]  # (1, block_k)
+            valid = jnp.logical_and(valid, jnp.logical_and(seg_q == seg_k, seg_q > 0))
         if causal:
             q_pos = q_index * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
             valid = jnp.logical_and(valid, q_pos >= k_pos)
@@ -259,20 +334,26 @@ def _bwd_dkv_kernel(
     do_ref,
     lse_ref,
     delta_ref,
-    dk_ref,
-    dv_ref,
-    *,
+    *rest,
     block_q: int,
     seq_q: int,
     causal: bool,
     sm_scale: float,
     block_k: int,
+    packed: bool = False,
 ):
     """dK/dV for one (batch*head, kv_block): stream Q blocks, recompute probabilities."""
+    if packed:
+        seg_q_ref, seg_k_ref, dk_ref, dv_ref = rest
+    else:
+        seg_q_ref = seg_k_ref = None
+        dk_ref, dv_ref = rest
     k_block = k_ref[0].astype(jnp.float32)  # (block_k, d)
     v_block = v_ref[0].astype(jnp.float32)
     kv_index = pl.program_id(1)
     kv_len = kv_len_ref[pl.program_id(0)]
+    # this program's fixed (1, block_k) key-segment row
+    seg_k = None if seg_k_ref is None else seg_k_ref[0]
 
     dk = jnp.zeros_like(k_block)
     dv = jnp.zeros_like(v_block)
@@ -290,6 +371,9 @@ def _bwd_dkv_kernel(
         )  # (block_q, block_k)
         k_pos = kv_index * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         valid = k_pos < kv_len
+        if seg_k is not None:
+            seg_q = seg_q_ref[0, pl.ds(q_idx * block_q, block_q), :]  # (block_q, 1)
+            valid = jnp.logical_and(valid, jnp.logical_and(seg_q == seg_k, seg_q > 0))
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
             valid = jnp.logical_and(valid, q_pos >= k_pos)
@@ -307,10 +391,14 @@ def _bwd_dkv_kernel(
         return dk, dv
 
     # causal: q blocks strictly above this kv block's diagonal contribute nothing;
-    # kv blocks entirely beyond kv_len (padding tail) skip the whole scan
+    # kv blocks entirely beyond kv_len (padding tail) skip the whole scan; packed
+    # rows also skip the q padding suffix (zero segment ids => zero contribution)
     first_block = (kv_index * block_k) // block_q if causal else 0
     in_range = kv_index * block_k < kv_len
-    last_block = jnp.where(in_range, num_q_blocks, first_block)
+    num_live_q_blocks = (
+        jnp.minimum(num_q_blocks, pl.cdiv(kv_len, block_q)) if packed else num_q_blocks
+    )
+    last_block = jnp.where(in_range, num_live_q_blocks, first_block)
     dk, dv = jax.lax.fori_loop(first_block, last_block, body, (dk, dv))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -329,6 +417,7 @@ def _flash_backward(
     block_q: int,
     block_k: int,
     interpret: bool,
+    segment_ids: Optional[jax.Array] = None,
 ):
     """Pallas flash backward: dq/dk/dv with O(seq) memory, probabilities recomputed."""
     batch, heads, seq_q, head_dim = q.shape
@@ -341,13 +430,32 @@ def _flash_backward(
     lse3 = lse.reshape(bh, seq_q, 1)
     # delta_i = rowsum(dO * O): the softmax-jacobian correction term
     delta3 = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1).reshape(bh, seq_q, 1)
+    packed = segment_ids is not None
+    if packed:
+        seg_q3, seg_k3, kv_lens = _segment_arrays(segment_ids, seq_q, seq_k)
     if kv_lens is None:
         kv_lens_bh = jnp.full((bh,), seq_k, dtype=jnp.int32)
     else:
         kv_lens_bh = jnp.repeat(kv_lens.astype(jnp.int32), heads)
 
+    seg_operands = [seg_q3, seg_k3] if packed else []
+    seg_specs = (
+        [
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b // heads, i, 0)),
+            pl.BlockSpec((1, 1, seq_k), lambda b, i: (b // heads, 0, 0)),
+        ]
+        if packed
+        else []
+    )
+
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, block_k=block_k, seq_k=seq_k, causal=causal, sm_scale=sm_scale, block_q=block_q
+        _bwd_dq_kernel,
+        block_k=block_k,
+        seq_k=seq_k,
+        causal=causal,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        packed=packed,
     )
     dq = pl.pallas_call(
         dq_kernel,
@@ -360,7 +468,8 @@ def _flash_backward(
             pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
+        ]
+        + seg_specs,
         out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
         cost_estimate=pl.CostEstimate(
@@ -369,10 +478,26 @@ def _flash_backward(
             transcendentals=bh * seq_q * seq_k,
         ),
         interpret=interpret,
-    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3)
+    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3, *seg_operands)
 
+    # the dkv grid iterates kv blocks: the key-segment operand is blocked, the
+    # query-segment row streams whole
+    dkv_seg_specs = (
+        [
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b // heads, 0, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j: (b // heads, 0, j)),
+        ]
+        if packed
+        else []
+    )
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, block_q=block_q, seq_q=seq_q, causal=causal, sm_scale=sm_scale, block_k=block_k
+        _bwd_dkv_kernel,
+        block_q=block_q,
+        seq_q=seq_q,
+        causal=causal,
+        sm_scale=sm_scale,
+        block_k=block_k,
+        packed=packed,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -386,7 +511,8 @@ def _flash_backward(
             pl.BlockSpec((1, seq_q, head_dim), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
-        ],
+        ]
+        + dkv_seg_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, head_dim), lambda b, j: (b, j, 0)),
@@ -401,18 +527,19 @@ def _flash_backward(
             transcendentals=bh * seq_q * seq_k,
         ),
         interpret=interpret,
-    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3)
+    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3, *seg_operands)
 
     unshape = lambda x, s: x.reshape(batch, heads, s, head_dim)
     return unshape(dq, seq_q), unshape(dk, seq_k), unshape(dv, seq_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     kv_lens: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
     causal: bool = False,
     sm_scale: Optional[float] = None,
     block_q: Optional[int] = None,
@@ -427,13 +554,22 @@ def flash_attention(
 
     :param kv_lens: optional (batch,) int32 valid KV lengths — the padding-mask case
         (keys at positions >= kv_lens[b] are masked for every head/query of batch b).
+    :param segment_ids: optional (batch, seq) int32 packed segment ids (0 =
+        padding, positive = segment; t5x convention): queries attend only keys of
+        their own segment, blockwise in-kernel — the packed-training regime where
+        the XLA path would need a dense (seq, seq) mask per row. Mutually exclusive
+        with ``kv_lens`` (padding is already encoded as id 0).
     :param block_q / block_k: Mosaic tile edges; ``None`` resolves through
         :func:`unionml_tpu.ops.tuning.pick_block_sizes` (measured winners when a
         ``bench_kernels.py`` sweep has recorded them, aligned defaults otherwise).
     """
+    if segment_ids is not None and kv_lens is not None:
+        raise ValueError("segment_ids already encodes padding; pass kv_lens=None")
     block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    return _flash_forward(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret)
+    return _flash_forward(
+        q, k, v, kv_lens, causal, scale, block_q, block_k, interpret, segment_ids=segment_ids
+    )
 
 
 def _resolve_blocks(q, k, block_q, block_k):
@@ -446,33 +582,51 @@ def _resolve_blocks(q, k, block_q, block_k):
     return block_q, block_k
 
 
-def _flash_fwd(q, k, v, kv_lens, causal, sm_scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, kv_lens, segment_ids, causal, sm_scale, block_q, block_k, interpret):
+    if segment_ids is not None and kv_lens is not None:
+        raise ValueError("segment_ids already encodes padding; pass kv_lens=None")
     block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     out, lse = _flash_forward(
-        q, k, v, kv_lens, causal, scale, block_q, block_k, interpret, return_residuals=True
+        q,
+        k,
+        v,
+        kv_lens,
+        causal,
+        scale,
+        block_q,
+        block_k,
+        interpret,
+        return_residuals=True,
+        segment_ids=segment_ids,
     )
     # the XLA-fallback backward recomputes from q/k/v: don't keep `out` alive for it
     residual_out = out if lse is not None else None
-    return out, (q, k, v, kv_lens, residual_out, lse)
+    return out, (q, k, v, kv_lens, segment_ids, residual_out, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
-    q, k, v, kv_lens, out, lse = residuals
+    q, k, v, kv_lens, segment_ids, out, lse = residuals
     block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     if lse is not None:
         dq, dk, dv = _flash_backward(
-            q, k, v, kv_lens, out, lse, g, causal, scale, block_q, block_k, interpret
+            q, k, v, kv_lens, out, lse, g, causal, scale, block_q, block_k, interpret,
+            segment_ids=segment_ids,
         )
-        return dq, dk, dv, None
+        return dq, dk, dv, None, None
     # irregular-shape path: differentiate the XLA reference instead
     mask = _kv_lens_to_mask(kv_lens, k.shape[-2]) if kv_lens is not None else None
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: xla_attention(q_, k_, v_, mask=mask, causal=causal, sm_scale=scale), q, k, v
+        lambda q_, k_, v_: xla_attention(
+            q_, k_, v_, mask=mask, causal=causal, sm_scale=scale, segment_ids=segment_ids
+        ),
+        q,
+        k,
+        v,
     )
     dq, dk, dv = vjp(g)
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -494,6 +648,7 @@ def attention(
     v: jax.Array,
     mask: Optional[jax.Array] = None,
     kv_lens: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
     causal: bool = False,
     sm_scale: Optional[float] = None,
     impl: str = "auto",
@@ -506,23 +661,34 @@ def attention(
     end-to-end by a 24% faster BERT-base train step; TPU_PROBES.log 2026-07-29).
     Dense ``mask`` arrays and non-TPU backends always take the XLA path;
     ``impl="pallas"`` forces the flash kernel with its tuned block sizes.
+
+    ``segment_ids`` selects the packed-sequence regime: on TPU the verdict comes
+    from :data:`unionml_tpu.ops.tuning.MEASURED_PACKED_IMPL` — here the pallas
+    kernel's blockwise segment comparison avoids the dense O(seq^2) mask the XLA
+    path must materialize per row.
     """
     if impl == "auto":
         if on_tpu() and mask is None:
-            from unionml_tpu.ops.tuning import pick_impl
+            from unionml_tpu.ops.tuning import pick_impl, pick_packed_impl
 
-            impl = pick_impl(q.shape[-2], k.shape[-2], q.shape[-1])
+            if segment_ids is not None:
+                impl = pick_packed_impl(q.shape[-2], k.shape[-2], q.shape[-1])
+            else:
+                impl = pick_impl(q.shape[-2], k.shape[-2], q.shape[-1])
         else:
             impl = "xla"
     if impl == "pallas":
         if mask is not None:
             raise ValueError(
                 "attention(impl='pallas') does not support dense masks; pass kv_lens "
-                "(right-padding) / causal, or use impl='xla' for arbitrary masks."
+                "(right-padding) / segment_ids (packing) / causal, or use impl='xla' "
+                "for arbitrary masks."
             )
-        return flash_attention(q, k, v, kv_lens, causal, sm_scale)
+        return flash_attention(q, k, v, kv_lens, segment_ids, causal, sm_scale)
     if impl == "xla":
         if mask is None and kv_lens is not None:
             mask = _kv_lens_to_mask(kv_lens, k.shape[-2])
-        return xla_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+        return xla_attention(
+            q, k, v, mask=mask, causal=causal, sm_scale=sm_scale, segment_ids=segment_ids
+        )
     raise ValueError(f"Unknown attention impl {impl!r}; expected 'auto', 'pallas', or 'xla'")

@@ -48,6 +48,22 @@ def pick_impl(seq_q: int, seq_k: int, head_dim: int) -> str:
     """Measured attention backend for a shape class ("xla" or "pallas")."""
     return MEASURED_IMPL.get((seq_q, seq_k, head_dim), DEFAULT_TPU_IMPL)
 
+
+#: measured pallas-vs-XLA verdicts for PACKED (segment-ids) shapes. The regimes
+#: differ structurally from the dense case: the XLA path must materialize a dense
+#: (seq, seq) mask per row (O(seq^2) HBM write + read), while the kernel compares
+#: segment ids blockwise in VMEM. Populated from bench_kernels.py --packed runs.
+MEASURED_PACKED_IMPL: Dict[Tuple[int, int, int], str] = {}
+
+#: unmeasured packed shapes: the kernel avoids the dense-mask materialization
+#: entirely; until a measurement says otherwise the structural argument decides
+DEFAULT_PACKED_IMPL = "pallas"
+
+
+def pick_packed_impl(seq_q: int, seq_k: int, head_dim: int) -> str:
+    """Measured attention backend for a packed (segment-ids) shape class."""
+    return MEASURED_PACKED_IMPL.get((seq_q, seq_k, head_dim), DEFAULT_PACKED_IMPL)
+
 #: candidate block edges for the sweep and the fallback ladder
 BLOCK_CANDIDATES: Tuple[int, ...] = (512, 256, 128, 64)
 
