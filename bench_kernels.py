@@ -152,6 +152,160 @@ def sweep_tpu(shapes, candidates):
     return results
 
 
+def _packed_segment_ids(rng, batch, seq, segments=4, pad_frac=0.1):
+    """Realistic packed rows: ``segments`` spans per row + a zero-padding suffix."""
+    import numpy as np
+
+    ids = np.zeros((batch, seq), dtype=np.int32)
+    live = seq - int(seq * pad_frac)
+    for b in range(batch):
+        cuts = np.sort(rng.choice(np.arange(1, live), size=segments - 1, replace=False))
+        bounds = np.concatenate([[0], cuts, [live]])
+        for s in range(segments):
+            ids[b, bounds[s] : bounds[s + 1]] = s + 1
+    return ids
+
+
+def sweep_packed_tpu(shapes, candidates):
+    """Packed (segment-ids) pallas-vs-XLA sweep -> MEASURED_PACKED_IMPL winners.
+
+    The structural question this answers: does the flash kernel's blockwise
+    segment comparison beat the XLA path's dense (seq, seq) mask materialization?
+    Output feeds ``ops/tuning.py::MEASURED_PACKED_IMPL`` (shape-class verdicts).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.ops.attention import flash_attention, xla_attention
+
+    results = {}
+    for batch, heads, seq, head_dim in shapes:
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(batch, heads, seq, head_dim)), dtype=jnp.bfloat16)
+            for _ in range(3)
+        )
+        seg = jnp.asarray(_packed_segment_ids(rng, batch, seq))
+
+        SCAN_N = 32  # same on-chip amortization as the dense sweep (tunnel noise)
+
+        def scanned_bwd(fn):
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+            grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+            @jax.jit
+            def run(q, k, v):
+                def body(c, _):
+                    dq, dk, dv = grad_fn(c, k, v)
+                    return (dq + 1e-30 * (dk + dv)).astype(c.dtype), None
+
+                out, _ = jax.lax.scan(body, q, None, length=SCAN_N)
+                return out
+
+            return run
+
+        xla_ms = _time(
+            scanned_bwd(lambda q, k, v: xla_attention(q, k, v, causal=True, segment_ids=seg)),
+            q, k, v, iters=3,
+        ) / SCAN_N
+        ref = xla_attention(q, k, v, causal=True, segment_ids=seg)  # block-size invariant
+
+        table = []
+        for block_q in candidates:
+            for block_k in candidates:
+                if seq % block_q or seq % block_k:
+                    continue
+                try:
+                    ms = _time(
+                        scanned_bwd(
+                            lambda q, k, v, bq=block_q, bk=block_k: flash_attention(
+                                q, k, v, segment_ids=seg, causal=True, block_q=bq, block_k=bk
+                            )
+                        ),
+                        q, k, v, iters=3,
+                    ) / SCAN_N
+                    out = flash_attention(q, k, v, segment_ids=seg, causal=True,
+                                          block_q=block_q, block_k=block_k)
+                    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+                    table.append({"block_q": block_q, "block_k": block_k,
+                                  "fwdbwd_ms": round(ms, 4), "max_err_vs_xla": err})
+                    print(f"[packed] seq={seq} bq={block_q} bk={block_k} "
+                          f"fwd+bwd={ms:.3f}ms (xla {xla_ms:.3f}ms)", file=sys.stderr)
+                except Exception as exc:
+                    table.append({"block_q": block_q, "block_k": block_k,
+                                  "error": f"{type(exc).__name__}: {exc}"})
+                    print(f"[packed] seq={seq} bq={block_q} bk={block_k} FAILED: {exc}",
+                          file=sys.stderr)
+
+        ok = [row for row in table if "fwdbwd_ms" in row]
+        best = min(ok, key=lambda r: r["fwdbwd_ms"]) if ok else None
+        results[f"b{batch}_h{heads}_s{seq}_d{head_dim}"] = {
+            "xla_fwdbwd_ms": round(xla_ms, 4),
+            "sweep": table,
+            "best": best,
+            "verdict": (
+                "use_pallas" if best and best["fwdbwd_ms"] < xla_ms else "use_xla"
+            ) if best is not None else "pallas_failed_use_xla",
+        }
+    return results
+
+
+def correctness_sweep_packed_cpu(shapes, candidates):
+    """CPU fallback for --packed: interpret-mode correctness per block config."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.ops.attention import flash_attention, xla_attention
+
+    results = {}
+    for batch, heads, seq, head_dim in shapes:
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(batch, heads, seq, head_dim)), dtype=jnp.float32)
+            for _ in range(3)
+        )
+        seg = jnp.asarray(_packed_segment_ids(rng, batch, seq, segments=3))
+        ref = xla_attention(q, k, v, causal=True, segment_ids=seg)
+        ref_grads = jax.grad(
+            lambda q, k, v: jnp.sum(xla_attention(q, k, v, causal=True, segment_ids=seg) ** 2),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        rows = []
+        for block_q in candidates:
+            for block_k in candidates:
+                if seq % block_q or seq % block_k:
+                    continue
+                out = flash_attention(q, k, v, segment_ids=seg, causal=True,
+                                      block_q=block_q, block_k=block_k, interpret=True)
+                err = float(jnp.max(jnp.abs(out - ref)))
+                # the packed backward's block-skip bound is block-size-dependent:
+                # vet dq/dk/dv per config, exactly like the dense CPU sweep
+                grads = jax.grad(
+                    lambda q, k, v, bq=block_q, bk=block_k: jnp.sum(
+                        flash_attention(q, k, v, segment_ids=seg, causal=True,
+                                        block_q=bq, block_k=bk, interpret=True) ** 2
+                    ),
+                    argnums=(0, 1, 2),
+                )(q, k, v)
+                grad_err = max(
+                    float(jnp.max(jnp.abs(g - r))) for g, r in zip(grads, ref_grads)
+                )
+                rows.append({"block_q": block_q, "block_k": block_k, "max_err": err,
+                             "max_grad_err": grad_err,
+                             "ok": err < 1e-4 and grad_err < 1e-2})
+        results[f"b{batch}_h{heads}_s{seq}_d{head_dim}"] = {
+            "mode": "cpu-interpret-correctness-only", "sweep": rows,
+            "all_ok": all(r["ok"] for r in rows),
+        }
+        print(f"[packed] seq={seq}: {len(rows)} block configs validated, "
+              f"all_ok={all(r['ok'] for r in rows)}", file=sys.stderr)
+    return results
+
+
 def correctness_sweep_cpu(shapes, candidates):
     """CPU fallback: validate every block config numerically in interpret mode."""
     import jax
@@ -205,6 +359,7 @@ def correctness_sweep_cpu(shapes, candidates):
 def main():
     import jax
 
+    packed_mode = "--packed" in sys.argv
     backend = jax.default_backend()
     # BERT-base fine-tune shapes + mid/long sequences + a head_dim-128 family
     # (GPT-2 context at 1024; 128-dim heads cover larger decoder configs)
@@ -217,18 +372,31 @@ def main():
     ]
     candidates = (128, 256, 512)
 
-    if backend == "cpu":
+    if packed_mode:
+        # packed training shapes (GPT: causal + segment ids)
+        shapes = [(8, 12, 128, 64), (4, 12, 512, 64), (2, 12, 1024, 64)]
+        if backend == "cpu":
+            shapes = [(2, 2, 128, 64)]
+            results = correctness_sweep_packed_cpu(shapes, candidates)
+            payload = {"backend": backend, "timing_valid": False, "results": results}
+        else:
+            results = sweep_packed_tpu(shapes, candidates)
+            payload = {"backend": backend, "timing_valid": True, "results": results}
+        out_path, metric = "PACKED_KERNEL_BENCH.json", "packed_kernel_sweep"
+    elif backend == "cpu":
         shapes = [(2, 2, 128, 64), (1, 2, 256, 64)]  # interpret mode is slow
         results = correctness_sweep_cpu(shapes, candidates)
         payload = {"backend": backend, "timing_valid": False, "results": results}
+        out_path, metric = "KERNEL_BENCH.json", "kernel_sweep"
     else:
         results = sweep_tpu(shapes, candidates)
         payload = {"backend": backend, "timing_valid": True, "results": results}
+        out_path, metric = "KERNEL_BENCH.json", "kernel_sweep"
 
     payload["recorded_at"] = datetime.now(timezone.utc).isoformat(timespec="seconds")
-    with open("KERNEL_BENCH.json", "w") as fh:
+    with open(out_path, "w") as fh:
         json.dump(payload, fh, indent=2)
-    print(json.dumps({"metric": "kernel_sweep", "backend": backend,
+    print(json.dumps({"metric": metric, "backend": backend,
                       "timing_valid": payload["timing_valid"],
                       "shapes": len(results)}))
 

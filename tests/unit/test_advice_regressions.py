@@ -309,3 +309,74 @@ def test_attribute_error_during_trace_falls_back_eagerly():
     out = tf(jnp.asarray([1.0, 2.0]))
     np.testing.assert_allclose(np.asarray(out), [1.0, 2.0])
     assert tf.uses_jit  # fallback was per-signature, not a permanent downgrade
+
+
+# ---------------------------------------------------------------- attention.py packed padding
+
+def _packed_qkv(rng, batch=2, heads=2, seq=128, dim=64):
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(batch, heads, seq, dim)), dtype=jnp.float32)
+        for _ in range(3)
+    )
+    return q, k, v
+
+
+def test_flash_packed_fully_padded_rows_emit_zeros():
+    """Round-3 ADVICE #1: fully-masked padding query rows (segment id 0) must emit
+    zeros — scores == new_max == -inf made exp() emit 1 per slot, so the row
+    produced a uniform V-average instead."""
+    from unionml_tpu.ops.attention import flash_attention
+
+    rng = np.random.default_rng(7)
+    q, k, v = _packed_qkv(rng)
+    seg = np.zeros((2, 128), dtype=np.int32)
+    seg[0, :40] = 1
+    seg[0, 40:100] = 2  # row 0: 28 padding positions
+    seg[1, :128] = 1    # row 1: no padding
+    seg = jnp.asarray(seg)
+    out = flash_attention(q, k, v, segment_ids=seg, interpret=True)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[0, :, 100:], np.zeros_like(out[0, :, 100:]))
+    ref = np.asarray(xla_attention(q, k, v, segment_ids=seg))
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_flash_packed_interior_zero_segment_ids_match_xla():
+    """Round-3 ADVICE #3: hand-built segment ids with INTERIOR zeros (padding not a
+    contiguous suffix) must degrade to masking, not silently skip live KV blocks."""
+    from unionml_tpu.ops.attention import flash_attention
+
+    rng = np.random.default_rng(11)
+    q, k, v = _packed_qkv(rng)
+    seg = np.zeros((2, 128), dtype=np.int32)
+    seg[0, :30] = 1
+    seg[0, 60:128] = 2  # interior zero gap at 30:60; live keys run to the end
+    seg[1, 10:120] = 1  # leading AND trailing zeros
+    seg = jnp.asarray(seg)
+    out = np.asarray(flash_attention(q, k, v, segment_ids=seg, interpret=True))
+    ref = np.asarray(xla_attention(q, k, v, segment_ids=seg))
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+    # and the gradient path: same check through the pallas backward
+    def loss_flash(q_):
+        return jnp.sum(flash_attention(q_, k, v, segment_ids=seg, interpret=True) ** 2)
+
+    def loss_xla(q_):
+        return jnp.sum(xla_attention(q_, k, v, segment_ids=seg) ** 2)
+
+    g_flash = np.asarray(jax.grad(loss_flash)(q))
+    g_xla = np.asarray(jax.grad(loss_xla)(q))
+    np.testing.assert_allclose(g_flash, g_xla, atol=5e-4)
+
+
+def test_attention_rejects_segment_ids_with_kv_lens_consistently():
+    """Round-3 ADVICE #4: the segment_ids/kv_lens mutual exclusion must hold for
+    every impl — previously impl='xla' silently combined both masks."""
+    from unionml_tpu.ops.attention import attention
+
+    rng = np.random.default_rng(13)
+    q, k, v = _packed_qkv(rng, batch=1, heads=1, seq=16, dim=8)
+    seg = jnp.ones((1, 16), dtype=jnp.int32)
+    lens = jnp.asarray([8], dtype=jnp.int32)
+    for impl in ("auto", "xla", "pallas"):
+        with pytest.raises(ValueError, match="segment_ids already encodes padding"):
+            attention(q, k, v, segment_ids=seg, kv_lens=lens, impl=impl)

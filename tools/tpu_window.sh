@@ -56,6 +56,7 @@ if ! run bench 600 python bench.py; then
 fi
 run mfu 700 python bench_mfu.py
 run kernels 900 python bench_kernels.py
+run packed 600 python bench_kernels.py --packed
 run serving 420 python bench_serving.py --bert-base
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log
 exit 0

@@ -35,8 +35,10 @@ from unionml_tpu.models.training import (
     create_train_state,
     dict_batches,
     fit,
+    fit_lm,
     make_classifier_eval_step,
     make_classifier_train_step,
+    make_lm_train_step,
 )
 
 __all__ = [
@@ -53,8 +55,10 @@ __all__ = [
     "GPTConfig",
     "GPTLMHeadModel",
     "MLPClassifier",
+    "fit_lm",
     "gpt_generate",
     "gpt_lm_loss",
+    "make_lm_train_step",
     "init_gpt_cache",
     "init_gpt_params",
     "TrainState",

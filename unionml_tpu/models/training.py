@@ -9,7 +9,7 @@ preemption-safe flush.
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -104,9 +104,13 @@ def make_classifier_train_step(
             metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
+    return _wrap_step(train_step, mesh, param_spec)
+
+
+def _wrap_step(train_step: Callable, mesh: Optional[Mesh], param_spec: Any) -> Callable:
+    """jit a ``(state, batch) -> (state, metrics)`` step, mesh-sharded when given."""
     if mesh is None:
         return jax.jit(train_step, donate_argnums=(0,))
-
     state_sharding = (
         jax.tree_util.tree_map(
             lambda spec: NamedSharding(mesh, spec),
@@ -118,9 +122,54 @@ def make_classifier_train_step(
     )
     return jax.jit(
         train_step,
-        in_shardings=(state_sharding if param_spec is not None else replicated(mesh), batch_sharding(mesh)),
+        in_shardings=(state_sharding, batch_sharding(mesh)),
         donate_argnums=(0,),
     )
+
+
+def make_lm_train_step(
+    mesh: Optional[Mesh] = None,
+    param_spec: Any = None,
+    packed: bool = False,
+    light_metrics: bool = False,
+) -> Callable:
+    """Compiled causal-LM train step ``(state, batch) -> (state, metrics)``.
+
+    ``batch`` carries ``"input_ids"`` plus, with ``packed=True``, the
+    ``"segment_ids"`` from :func:`unionml_tpu.ops.packing.pack_sequences` — the
+    model confines attention to same-segment tokens and restarts positions per
+    segment, and the loss masks cross-segment transitions
+    (:func:`unionml_tpu.models.gpt.lm_loss`). Unpacked batches may carry a
+    ``"mask"`` (1 = real token) for plain right-padded LM training.
+    """
+    from unionml_tpu.models.gpt import lm_loss
+
+    def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
+        # strict lookup: a packed step fed a batch without segment ids must fail
+        # loudly, not silently train across packed-sequence boundaries
+        segment_ids = batch["segment_ids"] if packed else None
+
+        def loss_fn(params):
+            logits = state.apply_fn(
+                {"params": params},
+                batch["input_ids"],
+                deterministic=False,
+                rngs={"dropout": dropout_rng},
+                segment_ids=segment_ids,
+            )
+            return lm_loss(
+                logits, batch["input_ids"], mask=batch.get("mask"), segment_ids=segment_ids
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {"loss": loss}
+        if not light_metrics:
+            metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    return _wrap_step(train_step, mesh, param_spec)
 
 
 def make_classifier_eval_step(input_signature: Tuple[str, ...] = ("inputs",)) -> Callable:
@@ -188,8 +237,14 @@ def fit(
     seed: int = 0,
     prefetch: bool = False,
     prefetch_convert: Optional[Dict[str, str]] = None,
+    step_fn: Optional[Callable] = None,
 ) -> FitResult:
     """Run the compiled train loop; resumes from ``checkpoint_dir`` when present.
+
+    ``step_fn`` overrides the default classifier step with any compiled
+    ``(state, batch) -> (state, metrics)`` — :func:`make_lm_train_step` and
+    :func:`fit_lm` route packed-LM training through here, so every loop feature
+    (checkpointing, prefetch, mesh batch layout, timing) is shared.
 
     ``prefetch=True`` gathers batches with the native threaded prefetcher
     (:class:`unionml_tpu.native.PrefetchLoader`), overlapping host-side batch assembly
@@ -202,7 +257,10 @@ def fit(
     ``prefetch=True`` — silently skipping a requested conversion would be a
     correctness trap.
     """
-    step_fn = make_classifier_train_step(mesh=mesh, param_spec=param_spec, input_signature=input_signature)
+    if step_fn is None:
+        step_fn = make_classifier_train_step(
+            mesh=mesh, param_spec=param_spec, input_signature=input_signature
+        )
 
     if prefetch_convert and not prefetch:
         raise ValueError("prefetch_convert requires prefetch=True (conversion runs in the native gather workers)")
@@ -315,6 +373,80 @@ def fit(
         examples_per_s=executed * batch_size / wall if wall > 0 else 0.0,
     )
     return result
+
+
+def fit_lm(
+    state: TrainState,
+    sequences: Sequence[np.ndarray],
+    *,
+    seq_len: int,
+    batch_size: int,
+    pack: bool = True,
+    max_segments_per_row: int = 0,
+    num_epochs: int = 1,
+    num_steps: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    param_spec: Any = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 100,
+    log_every: int = 50,
+    seed: int = 0,
+) -> FitResult:
+    """Causal-LM training over RAGGED token sequences through the shared fit loop.
+
+    ``pack=True`` (the default) runs
+    :func:`unionml_tpu.ops.packing.pack_sequences`: several short sequences share
+    each fixed-shape row, segment ids confine attention and restart positions per
+    segment, and cross-segment next-token transitions are masked out of the loss —
+    so a packed batch trains exactly as its sequences would alone while wasting no
+    MXU cycles on padding. ``pack=False`` right-pads one sequence per row with a
+    loss mask (the naive layout, kept for ablations).
+
+    This is the public packed-training entrypoint the reference cannot express at
+    all: its training loop is opaque user code (reference ``unionml/model.py:560``
+    runs the trainer inline), with no packing support anywhere.
+    """
+    from unionml_tpu.ops.packing import pack_sequences, packing_efficiency
+
+    if pack:
+        packed = pack_sequences(sequences, seq_len, max_segments_per_row=max_segments_per_row)
+        data = {"input_ids": packed["input_ids"], "segment_ids": packed["segment_ids"]}
+        logger.info(
+            "packed %d sequences into %d rows of %d (efficiency %.1f%%, %d truncated)",
+            len(sequences),
+            packed["input_ids"].shape[0],
+            seq_len,
+            100.0 * packing_efficiency(packed["segment_ids"]),
+            packed["truncated"],
+        )
+    else:
+        input_ids = np.zeros((len(sequences), seq_len), dtype=np.int32)
+        mask = np.zeros((len(sequences), seq_len), dtype=np.float32)
+        truncated = 0
+        for i, seq in enumerate(sequences):
+            arr = np.asarray(seq).reshape(-1)[:seq_len]
+            truncated += int(np.asarray(seq).size > seq_len)
+            input_ids[i, : arr.size] = arr
+            mask[i, : arr.size] = 1.0
+        if truncated:
+            logger.info("truncated %d sequences to seq_len=%d", truncated, seq_len)
+        data = {"input_ids": input_ids, "mask": mask}
+
+    step_fn = make_lm_train_step(mesh=mesh, param_spec=param_spec, packed=pack)
+    return fit(
+        state,
+        data,
+        batch_size=batch_size,
+        num_epochs=num_epochs,
+        num_steps=num_steps,
+        mesh=mesh,
+        param_spec=param_spec,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        log_every=log_every,
+        seed=seed,
+        step_fn=step_fn,
+    )
 
 
 def bert_flops_per_token(config: Any) -> float:

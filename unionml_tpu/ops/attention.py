@@ -136,7 +136,11 @@ def _flash_kernel(
 
         new_max = jnp.maximum(row_max, jnp.max(scores, axis=-1, keepdims=True))
         correction = jnp.exp(row_max - new_max)
-        probs = jnp.exp(scores - new_max)
+        # masked slots must contribute exactly 0: for a live row exp(scores - new_max)
+        # already underflows to 0 there, but for a FULLY-masked row (packed padding)
+        # new_max == scores == _NEG_INF and exp(0) would be 1 — the where() is what
+        # keeps row_sum at 0 so such rows divide to zeros below
+        probs = jnp.where(valid, jnp.exp(scores - new_max), 0.0)
         acc = acc * correction + jax.lax.dot_general(
             probs, v_block, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -148,8 +152,9 @@ def _flash_kernel(
     if causal:
         last_block = jnp.minimum(last_block, pl.cdiv((q_index + 1) * block_q, block_k))
     acc, row_max, row_sum = jax.lax.fori_loop(0, last_block, body, (acc, row_max, row_sum))
-    # row_sum == 0 (fully-masked row: padding in a packed batch) divides to 0, which
-    # matches the zeroed-row convention of the XLA reference and the ring kernel
+    # fully-masked rows (packed padding) carry acc == row_sum == 0 — the masked probs
+    # above guarantee it — so the guarded divide emits the zeros the XLA reference
+    # and the ring kernel produce for such rows
     o_ref[0] = (acc / jnp.maximum(row_sum, 1e-30)).astype(o_ref.dtype)
     if lse_ref is not None:
         # logsumexp of the (scaled, masked) scores — the residual the backward needs
@@ -168,13 +173,17 @@ def _segment_arrays(segment_ids: jax.Array, seq_q: int, seq_k: int):
 
     Returns ``(seg_q3, seg_k3, kv_lens)``: (batch, seq_q, 1) and (batch, 1, seq_k)
     int32 views (the trailing/leading singleton keeps blocks on the proven
-    (block, 1)/(1, block) tilings) plus the per-row valid length — packing keeps
-    padding as a zero-id suffix, so the block-skip bound stays exact.
+    (block, 1)/(1, block) tilings) plus the per-row valid length. kv_len is the
+    last-nonzero index + 1 (not the nonzero COUNT): pack_sequences emits padding
+    as a contiguous zero suffix where the two agree, but hand-built ids with
+    interior zeros must degrade to in-block masking — counting would silently
+    skip trailing live blocks.
     """
     ids = segment_ids.astype(jnp.int32)
     seg_q3 = ids[:, :seq_q, None]
     seg_k3 = ids[:, None, :seq_k]
-    kv_lens = jnp.sum((ids[:, :seq_k] > 0).astype(jnp.int32), axis=-1)
+    positions = jnp.arange(seq_k, dtype=jnp.int32)[None, :]
+    kv_lens = jnp.max(jnp.where(ids[:, :seq_k] > 0, positions + 1, 0), axis=-1)
     return seg_q3, seg_k3, kv_lens
 
 
@@ -667,6 +676,10 @@ def attention(
     kernel's blockwise segment comparison avoids the dense O(seq^2) mask the XLA
     path must materialize per row.
     """
+    if segment_ids is not None and kv_lens is not None:
+        # enforced here (not only in flash_attention) so the XLA path rejects the
+        # combination identically instead of silently combining both masks
+        raise ValueError("segment_ids already encodes padding; pass kv_lens=None")
     if impl == "auto":
         if on_tpu() and mask is None:
             from unionml_tpu.ops.tuning import pick_impl, pick_packed_impl

@@ -52,12 +52,15 @@ def pick_impl(seq_q: int, seq_k: int, head_dim: int) -> str:
 #: measured pallas-vs-XLA verdicts for PACKED (segment-ids) shapes. The regimes
 #: differ structurally from the dense case: the XLA path must materialize a dense
 #: (seq, seq) mask per row (O(seq^2) HBM write + read), while the kernel compares
-#: segment ids blockwise in VMEM. Populated from bench_kernels.py --packed runs.
+#: segment ids blockwise in VMEM. Populated from ``bench_kernels.py --packed``
+#: runs on real hardware (PACKED_KERNEL_BENCH.json).
 MEASURED_PACKED_IMPL: Dict[Tuple[int, int, int], str] = {}
 
-#: unmeasured packed shapes: the kernel avoids the dense-mask materialization
-#: entirely; until a measurement says otherwise the structural argument decides
-DEFAULT_PACKED_IMPL = "pallas"
+#: unmeasured packed shapes follow the measured dense-shape trend (XLA wins or
+#: ties every measured practical shape on v5e). The kernel's structural edge —
+#: no dense O(seq^2) mask — is plausible but UNMEASURED; an unmeasured default
+#: must be the conservative one. A ``--packed`` sweep flips this per shape class.
+DEFAULT_PACKED_IMPL = "xla"
 
 
 def pick_packed_impl(seq_q: int, seq_k: int, head_dim: int) -> str:
