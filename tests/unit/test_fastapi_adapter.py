@@ -8,6 +8,7 @@ payload semantics, health states) executes for real; only the web framework is f
 """
 
 import asyncio
+import json
 import sys
 import types
 
@@ -157,3 +158,46 @@ def test_health_without_artifact(tmp_path, monkeypatch, fake_fastapi_env):
     with pytest.raises(_FakeHTTPException) as excinfo:
         asyncio.run(app.routes[("GET", "/health")]())
     assert excinfo.value.status_code == 500
+
+
+# --------------------------------------------------------- real-fastapi end to end
+# VERDICT r3 #7: with the real optional dep installed (the CI optional-deps leg),
+# the adapter serves actual HTTP through fastapi's TestClient — no fakes anywhere.
+
+def _real_fastapi_available() -> bool:
+    import importlib.util
+
+    return (
+        importlib.util.find_spec("fastapi") is not None
+        and importlib.util.find_spec("httpx") is not None
+    )
+
+
+@pytest.mark.skipif(not _real_fastapi_available(), reason="fastapi not installed")
+def test_real_fastapi_serves_end_to_end(tmp_path, monkeypatch):
+    sys.modules.pop(_ADAPTER_MODULE, None)  # never reuse a fake-bound adapter
+    from fastapi import FastAPI
+    from fastapi.testclient import TestClient
+
+    from unionml_tpu.serving.fastapi_adapter import attach_fastapi
+
+    model = make_sklearn_model()
+    model.train(hyperparameters={"C": 1.0, "max_iter": 300})
+    path = tmp_path / "model.joblib"
+    model.save(path)
+    model._artifact = None
+    monkeypatch.setenv("UNIONML_MODEL_PATH", str(path))
+
+    app = attach_fastapi(model, FastAPI())
+    with TestClient(app) as client:  # context manager fires the startup hook
+        assert client.get("/health").json() == {"message": "OK", "status": 200}
+        response = client.post(
+            "/predict", json={"features": [{"x1": 0.5, "x2": -1.0}, {"x1": -2.0, "x2": 2.0}]}
+        )
+        assert response.status_code == 200
+        predictions = response.json()
+        assert len(predictions) == 2
+        # reference-parity error contract: no payload -> HTTP error, clear message
+        bad = client.post("/predict", json={})
+        assert bad.status_code >= 400
+        assert "inputs or features" in json.dumps(bad.json())

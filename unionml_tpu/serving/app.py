@@ -322,6 +322,9 @@ def build_aiohttp_app(
 
     async def stats(request):
         payload = {"model": model.name, "resident": predictor is not None}
+        if predictor is not None and hasattr(predictor, "device_stats"):
+            # server-side device latency (dispatch + fetch), split from HTTP RTT
+            payload["device_latency"] = predictor.device_stats()
         gen = request.app.get("continuous_batcher")
         if gen is not None:
             payload["generation"] = {
