@@ -82,6 +82,10 @@ def run_sweep():
             ("b64_nomask", dict(batch=64, seq=128, mask=False)),
             ("b64_no_gradnorm_metric", dict(batch=64, seq=128, light_metrics=True)),
             ("s512_b16", dict(batch=16, seq=512)),
+            # remat trades recompute FLOPs for HBM: the batch sizes the plain
+            # ladder OOMs at become reachable, where MXU tiles are largest
+            ("b256_remat", dict(batch=256, seq=128, config=dict(remat=True))),
+            ("b512_remat", dict(batch=512, seq=128, config=dict(remat=True))),
         ]
         config_cls = BertConfig.base
     else:  # CPU smoke of the harness itself

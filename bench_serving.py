@@ -95,8 +95,10 @@ def bench_mlp():
     request = [dict(zip(feature_names, np.random.default_rng(1).normal(size=64)))]
     stats = _measure(lambda: resident.predict(features=request))
     # device-vs-end-to-end split (VERDICT r3 #8): the resident predictor's own
-    # timer covers dispatch + device->host fetch only (no feature pipeline)
-    stats.update(resident.device_stats())
+    # timer covers dispatch + device->host fetch only (no feature pipeline);
+    # 'count' is dropped like bench_http does (it differs from iters by the
+    # warm request and would read as a conflicting iteration count)
+    stats.update({k: v for k, v in resident.device_stats().items() if k != "count"})
     return stats
 
 
@@ -190,7 +192,7 @@ def bench_bert(base: bool = False, seq_bucket: int = 128):
     )
     resident.setup()
     stats = _measure(lambda: resident.predict(features=example), iters=100)
-    stats.update(resident.device_stats())
+    stats.update({k: v for k, v in resident.device_stats().items() if k != "count"})
     return stats
 
 

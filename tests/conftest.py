@@ -24,7 +24,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# compile-time dominates the suite's wall-clock on CPU (a single-core box pays
+# every XLA optimization pass serially); level 0 cuts compile ~2x with the whole
+# suite still green — tests assert semantics, never CPU performance. Benches and
+# production paths never read this (it is pytest-conftest scoped).
+if "xla_backend_optimization_level" not in _flags:
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 # persistent compilation cache: the suite's wall-clock is dominated by XLA compiles
 # of shape-stable programs (parallel/gpt/continuous suites); cache them across runs

@@ -157,7 +157,7 @@ def test_moe_template_trains_and_generates(tmp_path):
     target = render_template("moe-textgen", "moe_app", tmp_path)
     namespace = runpy.run_path(str(target / "app.py"), run_name="not_main")
     model = namespace["model"]
-    state, metrics = model.train(trainer_kwargs={"num_steps": 30, "batch_size": 16})
+    state, metrics = model.train(trainer_kwargs={"num_steps": 10, "batch_size": 16})
     assert metrics["train"] > 0
     out = model.predict(features={"prompt": ["the quick "], "max_new_tokens": 8})
     assert out.shape[1] == len("the quick ") + 8

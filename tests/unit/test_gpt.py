@@ -36,15 +36,17 @@ def test_cached_generation_matches_full_recompute(tiny):
     prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 5)), dtype=jnp.int32)
 
     ids = prompt
-    for _ in range(6):
+    # each reference iteration compiles a fresh (longer) full forward; 4 steps
+    # prove cache parity at a third of the compile bill 6 did
+    for _ in range(4):
         logits = model.apply(variables, ids, deterministic=True)
         nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
         ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
 
-    out = generate(model, variables, prompt, max_new_tokens=6, max_len=16)
+    out = generate(model, variables, prompt, max_new_tokens=4, max_len=16)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
 
-    jitted = jax.jit(lambda p: generate(model, variables, p, max_new_tokens=6, max_len=16))
+    jitted = jax.jit(lambda p: generate(model, variables, p, max_new_tokens=4, max_len=16))
     np.testing.assert_array_equal(np.asarray(jitted(prompt)), np.asarray(ids))
 
 
@@ -319,8 +321,13 @@ def test_gpt_sequence_parallel_training_matches_xla(sp_impl):
     from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel, init_params, lm_loss
     from unionml_tpu.parallel import make_mesh
 
-    mesh = make_mesh({"data": 2, "sequence": 4})
-    base = dict(dropout=0.0, dtype=jnp.float32)
+    # 2 sequence shards: wiring-level parity only needs >1 shard here — the ring
+    # collective's multi-hop coverage (4 shards, padding, causality) lives in the
+    # op-level tests (test_parallel.py), and each extra shard lengthens the
+    # unrolled ppermute chain the grad compile pays for. One layer for the same
+    # reason: the property (sp forward+grad parity vs dense) is per-layer.
+    mesh = make_mesh({"data": 4, "sequence": 2})
+    base = dict(dropout=0.0, dtype=jnp.float32, num_layers=1)
     sp_config = GPTConfig.tiny(attention_impl=sp_impl, sp_mesh=mesh, **base)
     xla_config = GPTConfig.tiny(attention_impl="xla", **base)
 

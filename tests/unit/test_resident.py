@@ -233,5 +233,7 @@ def test_resident_device_stats_record_per_request_latency():
     for _ in range(5):
         resident.predict(features=[{"len": 3}])
     stats = resident.device_stats()
-    assert stats["count"] == 5
+    # the FIRST call at a new padded shape pays trace+compile and is excluded —
+    # recording it would plant a bogus compile-time outlier in device_p99_ms
+    assert stats["count"] == 4
     assert 0 < stats["device_p50_ms"] <= stats["device_p99_ms"]

@@ -1,0 +1,109 @@
+"""Build the docs corpus into a static HTML site (the reference's sphinx analogue).
+
+The reference ships a sphinx build (``/root/reference/docs/Makefile`` +
+``docs/source/conf.py``); this environment has no sphinx, so the build target is
+self-contained: every markdown page (guides + generated ``docs/api/`` reference)
+renders through python-markdown, every notebook through nbconvert, and an index
+ties them together. ``make -C docs html`` (or ``python tools/build_docs.py``)
+writes ``docs/_build/html/``.
+"""
+
+import pathlib
+import shutil
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+DOCS = REPO / "docs"
+OUT = DOCS / "_build" / "html"
+
+PAGE_TEMPLATE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>{title} — unionml-tpu</title>
+<style>
+body {{ font-family: -apple-system, 'Segoe UI', Roboto, sans-serif; max-width: 56rem;
+       margin: 2rem auto; padding: 0 1rem; line-height: 1.55; color: #1a1a1a; }}
+pre {{ background: #f6f8fa; padding: .75rem 1rem; overflow-x: auto; border-radius: 6px; }}
+code {{ background: #f6f8fa; padding: .1em .3em; border-radius: 4px; font-size: .92em; }}
+pre code {{ background: none; padding: 0; }}
+table {{ border-collapse: collapse; }} th, td {{ border: 1px solid #d0d7de; padding: .35rem .6rem; }}
+a {{ color: #0b57d0; }} nav {{ margin-bottom: 1.5rem; font-size: .9em; }}
+</style>
+</head>
+<body>
+<nav><a href="{root}index.html">unionml-tpu docs</a></nav>
+{body}
+</body>
+</html>
+"""
+
+
+def _render_markdown(text: str) -> str:
+    import markdown
+
+    return markdown.markdown(
+        text, extensions=["fenced_code", "tables", "toc"], output_format="html5"
+    )
+
+
+def _title_of(md_text: str, fallback: str) -> str:
+    for line in md_text.splitlines():
+        if line.startswith("# "):
+            return line[2:].strip()
+    return fallback
+
+
+def build() -> pathlib.Path:
+    if OUT.exists():
+        shutil.rmtree(OUT)
+    (OUT / "api").mkdir(parents=True)
+    (OUT / "notebooks").mkdir(parents=True)
+
+    pages = []  # (relative html path, title)
+    for md_path in sorted(DOCS.glob("*.md")) + sorted((DOCS / "api").glob("*.md")):
+        rel_dir = md_path.parent.relative_to(DOCS)
+        text = md_path.read_text()
+        title = _title_of(text, md_path.stem)
+        out_path = OUT / rel_dir / (md_path.stem + ".html")
+        root = "../" if rel_dir.parts else ""
+        out_path.write_text(
+            PAGE_TEMPLATE.format(title=title, body=_render_markdown(text), root=root)
+        )
+        pages.append((str(rel_dir / (md_path.stem + ".html")).lstrip("./"), title))
+
+    notebook_pages = []
+    try:
+        import nbformat
+        from nbconvert import HTMLExporter
+
+        exporter = HTMLExporter()
+        for nb_path in sorted((DOCS / "notebooks").glob("*.ipynb")):
+            nb = nbformat.read(nb_path, as_version=4)
+            body, _ = exporter.from_notebook_node(nb)
+            out_path = OUT / "notebooks" / (nb_path.stem + ".html")
+            out_path.write_text(body)
+            notebook_pages.append((f"notebooks/{nb_path.stem}.html", nb_path.stem.replace("_", " ")))
+    except Exception as exc:  # pragma: no cover - nbconvert is present in this image
+        print(f"[build_docs] notebook export skipped: {exc}", file=sys.stderr)
+
+    # prepend a generated table of contents to the landing page
+    index_md = (DOCS / "index.md").read_text()
+    toc = ["\n\n## All pages\n"]
+    toc += [f"- [{title}]({rel})" for rel, title in pages if rel != "index.html"]
+    if notebook_pages:
+        toc.append("\n### Notebook tutorials\n")
+        toc += [f"- [{title}]({rel})" for rel, title in notebook_pages]
+    (OUT / "index.html").write_text(
+        PAGE_TEMPLATE.format(
+            title=_title_of(index_md, "unionml-tpu"),
+            body=_render_markdown(index_md + "\n".join(toc)),
+            root="",
+        )
+    )
+    print(f"[build_docs] wrote {sum(1 for _ in OUT.rglob('*.html'))} pages to {OUT}")
+    return OUT
+
+
+if __name__ == "__main__":
+    build()

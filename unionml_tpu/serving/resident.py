@@ -74,6 +74,10 @@ class ResidentPredictor:
         # loop; the lock keeps the snapshot safe (deques error on mutation mid-iter)
         self._device_times_ms: deque = deque(maxlen=2048)
         self._device_times_lock = threading.Lock()
+        # shape signatures whose executable has already run once: the FIRST call
+        # at a new padded shape pays trace+compile, which must not be recorded as
+        # steady-state device latency (it would sit in the window as a bogus p99)
+        self._timed_shapes: set = set()
 
     def device_stats(self) -> dict:
         """Percentiles of the compiled executable's per-request wall time."""
@@ -220,6 +224,10 @@ class ResidentPredictor:
         except ValueError:
             return self._model.predict(features=features, **reader_kwargs)
 
+        shape_sig = tuple(
+            (getattr(leaf, "shape", None), str(getattr(leaf, "dtype", "")))
+            for leaf in jax.tree_util.tree_leaves(padded)
+        )
         t0 = time.perf_counter()
         try:
             predictions = self._compiled(self._device_model_object, padded)
@@ -228,8 +236,12 @@ class ResidentPredictor:
             self._compiled = None
             return self._model.predict(features=features, **reader_kwargs)
         predictions = jax.device_get(predictions)  # the fetch is the device barrier
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
         with self._device_times_lock:
-            self._device_times_ms.append((time.perf_counter() - t0) * 1e3)
+            if shape_sig in self._timed_shapes:
+                self._device_times_ms.append(elapsed_ms)
+            else:  # first call at this shape paid trace+compile: never record it
+                self._timed_shapes.add(shape_sig)
         # slice the padding off every batch-shaped leaf (predictor outputs may be pytrees)
         result = jax.tree_util.tree_map(
             lambda leaf: leaf[:n]
