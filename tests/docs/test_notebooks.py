@@ -30,8 +30,7 @@ def test_notebook_executes(path):
         source = cell.source
         if not source.strip():
             continue
-        try:
-            # compile in 'exec' mode: trailing-expression display cells still run
-            exec(compile(source, f"{path.name}:cell{index}", "exec"), namespace)
-        except Exception as exc:  # pragma: no cover - failure reporting
-            pytest.fail(f"{path.name} cell {index} raised {type(exc).__name__}: {exc}")
+        # compile in 'exec' mode: trailing-expression display cells still run;
+        # raising straight through keeps the full traceback (the compile() stamps
+        # the cell as the filename, so the failing cell is still identifiable)
+        exec(compile(source, f"{path.name}:cell{index}", "exec"), namespace)

@@ -16,6 +16,7 @@ def test_list_templates():
     assert set(list_templates()) >= {
         "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
         "serverless", "torch-digits", "keras-mnist", "gpt-textgen", "moe-textgen",
+        "packed-textgen",
     }
 
 
@@ -24,6 +25,7 @@ def test_list_templates():
     [
         "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
         "serverless", "torch-digits", "keras-mnist", "gpt-textgen", "moe-textgen",
+        "packed-textgen",
     ],
 )
 def test_render_template_compiles(template, tmp_path):
@@ -158,6 +160,20 @@ def test_moe_template_trains_and_generates(tmp_path):
     namespace = runpy.run_path(str(target / "app.py"), run_name="not_main")
     model = namespace["model"]
     state, metrics = model.train(trainer_kwargs={"num_steps": 10, "batch_size": 16})
+    assert metrics["train"] > 0
+    out = model.predict(features={"prompt": ["the quick "], "max_new_tokens": 8})
+    assert out.shape[1] == len("the quick ") + 8
+
+
+def test_packed_template_trains_and_generates(tmp_path):
+    """The packed-textgen template runs end to end: ragged corpus -> fit_lm(pack=True)
+    through the decorator API -> KV-cache generation."""
+    import runpy
+
+    target = render_template("packed-textgen", "packed_app", tmp_path)
+    namespace = runpy.run_path(str(target / "app.py"), run_name="not_main")
+    model = namespace["model"]
+    state, metrics = model.train(trainer_kwargs={"num_epochs": 3, "batch_size": 8})
     assert metrics["train"] > 0
     out = model.predict(features={"prompt": ["the quick "], "max_new_tokens": 8})
     assert out.shape[1] == len("the quick ") + 8

@@ -233,3 +233,22 @@ def test_sql_reader_full_train_predict(tmp_path):
     assert len(predictions) == 10
     predictions = model.predict(features=[{"a": 3.0, "b": 3.0}])
     assert predictions == [1.0]
+
+
+def test_default_splitter_keeps_ragged_list_columns():
+    """Ragged columns (variable-length token sequences) split as python lists —
+    np.asarray on inhomogeneous shapes would raise (packed-LM reader contract)."""
+    from unionml_tpu import Dataset
+
+    dataset = Dataset(name="ragged_ds", test_size=0.25, shuffle=True, random_state=7)
+
+    @dataset.reader
+    def reader() -> dict:
+        return {"sequences": [[1], [2, 2], [3, 3, 3], [4, 4, 4, 4]], "flat": [10, 20, 30, 40]}
+
+    splits = dataset.get_data(reader())
+    train_f, test_f = splits["train"][0], splits["test"][0]
+    all_seqs = sorted(map(tuple, train_f["sequences"] + test_f["sequences"]))
+    assert all_seqs == [(1,), (2, 2), (3, 3, 3), (4, 4, 4, 4)]
+    assert isinstance(train_f["sequences"], list)
+    assert len(test_f["sequences"]) == 1

@@ -9,6 +9,7 @@ writes ``docs/_build/html/``.
 """
 
 import pathlib
+import re
 import shutil
 import sys
 
@@ -42,9 +43,12 @@ a {{ color: #0b57d0; }} nav {{ margin-bottom: 1.5rem; font-size: .9em; }}
 def _render_markdown(text: str) -> str:
     import markdown
 
-    return markdown.markdown(
+    html = markdown.markdown(
         text, extensions=["fenced_code", "tables", "toc"], output_format="html5"
     )
+    # internal cross-page links point at the source .md files; the built site
+    # only contains .html, so rewrite relative hrefs (external URLs untouched)
+    return re.sub(r'(href="(?!https?://|#)[^"]+)\.md(["#])', r"\1.html\2", html)
 
 
 def _title_of(md_text: str, fallback: str) -> str:
@@ -59,9 +63,15 @@ def build() -> pathlib.Path:
         shutil.rmtree(OUT)
     (OUT / "api").mkdir(parents=True)
     (OUT / "notebooks").mkdir(parents=True)
+    (OUT / "tutorials").mkdir(parents=True)
 
     pages = []  # (relative html path, title)
-    for md_path in sorted(DOCS.glob("*.md")) + sorted((DOCS / "api").glob("*.md")):
+    sources = (
+        sorted(DOCS.glob("*.md"))
+        + sorted((DOCS / "api").glob("*.md"))
+        + sorted((DOCS / "tutorials").glob("*.md"))
+    )
+    for md_path in sources:
         rel_dir = md_path.parent.relative_to(DOCS)
         text = md_path.read_text()
         title = _title_of(text, md_path.stem)

@@ -418,12 +418,26 @@ class Dataset(TrackedInstance):
             indices = np.random.default_rng(random_state).permutation(n_rows)
         train_idx, test_idx = indices[: n_rows - n_test], indices[n_rows - n_test :]
 
+        def take_rows(value, subset):
+            if isinstance(value, (list, tuple)):
+                try:
+                    array = np.asarray(value)
+                except ValueError:
+                    array = np.empty(0, dtype=object)
+                if array.dtype == object:
+                    # only RAGGED columns (variable-length token sequences for
+                    # packed LM training) stay python lists; rectangular list
+                    # columns keep becoming arrays as they always have
+                    return [value[i] for i in subset]
+                return array[subset]
+            return np.asarray(value)[subset]
+
         def take(subset):
             if isinstance(data, pd.DataFrame):
                 return data.iloc[subset]
             if isinstance(data, np.ndarray):
                 return data[subset]
-            return {k: np.asarray(v)[subset] for k, v in data.items()}
+            return {k: take_rows(v, subset) for k, v in data.items()}
 
         return take(train_idx), take(test_idx)
 
