@@ -280,3 +280,94 @@ def test_fit_lm_packed_matches_unpacked_initial_loss():
     # lr=0 keeps params fixed, so both layouts score the same model; the averages
     # differ only by which (identical) transitions each layout weights
     np.testing.assert_allclose(first_loss(True), first_loss(False), rtol=2e-5)
+
+
+def test_lm_eval_step_perplexity_packed_matches_padded():
+    """make_lm_eval_step: same data, packed vs padded layouts -> same perplexity."""
+    from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel, init_params
+    from unionml_tpu.models.training import create_train_state, make_lm_eval_step
+
+    config = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32)
+    model = GPTLMHeadModel(config)
+    variables = init_params(config, rng=jax.random.PRNGKey(0), seq_len=16)
+    state = create_train_state(model, variables, learning_rate=0.0)
+    rng = np.random.default_rng(9)
+    seqs = [rng.integers(1, config.vocab_size, size=int(n)) for n in (9, 7, 5, 10)]
+
+    packed = pack_sequences(seqs, 16)
+    packed_metrics = make_lm_eval_step(packed=True)(
+        state,
+        {"input_ids": jnp.asarray(packed["input_ids"]),
+         "segment_ids": jnp.asarray(packed["segment_ids"])},
+    )
+
+    ids = np.zeros((4, 16), np.int32)
+    mask = np.zeros((4, 16), np.float32)
+    for i, s in enumerate(seqs):
+        a = np.asarray(s); ids[i, : a.size] = a; mask[i, : a.size] = 1.0
+    padded_metrics = make_lm_eval_step()(
+        state, {"input_ids": jnp.asarray(ids), "mask": jnp.asarray(mask)}
+    )
+    np.testing.assert_allclose(
+        float(packed_metrics["perplexity"]), float(padded_metrics["perplexity"]), rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        float(packed_metrics["perplexity"]), float(np.exp(packed_metrics["loss"])), rtol=1e-6
+    )
+
+
+def test_flash_packed_noncontiguous_duplicate_ids_match_xla():
+    """Block-skip bounds must follow ID EQUALITY, not run boundaries: a row that
+    reuses a segment id non-contiguously still attends across the gap exactly
+    like the dense XLA reference (t5x semantics are pure id equality)."""
+    rng = np.random.default_rng(21)
+    q, k, v = _rand_qkv(rng, 1, 2, 64, 64)
+    segs = np.zeros((1, 64), np.int32)
+    segs[0, :16] = 1
+    segs[0, 16:40] = 2
+    segs[0, 40:56] = 1  # id 1 again, non-contiguous
+    segs = jnp.asarray(segs)
+    for causal in (False, True):
+        out = flash_attention(q, k, v, segment_ids=segs, causal=causal, interpret=True, **BLOCKS)
+        ref = xla_attention(q, k, v, segment_ids=segs, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def loss_flash(a):
+        return jnp.sum(flash_attention(a, k, v, segment_ids=segs, causal=True, interpret=True, **BLOCKS) ** 2)
+
+    def loss_xla(a):
+        return jnp.sum(xla_attention(a, k, v, segment_ids=segs, causal=True) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_flash)(q)), np.asarray(jax.grad(loss_xla)(q)), atol=1e-4
+    )
+
+
+def test_flash_packed_cross_length_matches_xla():
+    """seq_q != seq_k packed attention: block-skip bounds and masks are computed
+    from per-axis id slices (round-4 review regression: bounds indexed with the
+    q-grid stride into a kv-width array, corrupting batch rows > 0)."""
+    rng = np.random.default_rng(23)
+    q = jnp.asarray(rng.normal(size=(2, 2, 32, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 2, 64, 64)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 2, 64, 64)), jnp.float32)
+    segs = np.zeros((2, 64), np.int32)
+    segs[0, :30] = 1
+    segs[0, 30:50] = 2
+    segs[1, :20] = 1
+    segs[1, 20:64] = 2
+    segs = jnp.asarray(segs)
+    out = flash_attention(q, k, v, segment_ids=segs, interpret=True, **BLOCKS)
+    ref = xla_attention(q, k, v, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def loss_flash(a, b, c):
+        return jnp.sum(flash_attention(a, b, c, segment_ids=segs, interpret=True, **BLOCKS) ** 2)
+
+    def loss_xla(a, b, c):
+        return jnp.sum(xla_attention(a, b, c, segment_ids=segs) ** 2)
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_x = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)

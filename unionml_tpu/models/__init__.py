@@ -38,6 +38,7 @@ from unionml_tpu.models.training import (
     fit_lm,
     make_classifier_eval_step,
     make_classifier_train_step,
+    make_lm_eval_step,
     make_lm_train_step,
 )
 
@@ -58,6 +59,7 @@ __all__ = [
     "fit_lm",
     "gpt_generate",
     "gpt_lm_loss",
+    "make_lm_eval_step",
     "make_lm_train_step",
     "init_gpt_cache",
     "init_gpt_params",

@@ -172,6 +172,29 @@ def make_lm_train_step(
     return _wrap_step(train_step, mesh, param_spec)
 
 
+def make_lm_eval_step(packed: bool = False) -> Callable:
+    """Compiled causal-LM eval step ``(state, batch) -> metrics``.
+
+    Returns per-token ``loss`` and ``perplexity`` (exp of the masked mean
+    next-token cross-entropy) over the batch's real transitions — the LM
+    counterpart of :func:`make_classifier_eval_step`, sharing
+    :func:`make_lm_train_step`'s batch contract (``input_ids`` plus
+    ``segment_ids`` when packed / optional ``mask`` otherwise).
+    """
+    from unionml_tpu.models.gpt import lm_loss
+
+    def eval_step(state: TrainState, batch: Dict[str, jax.Array]):
+        segment_ids = batch["segment_ids"] if packed else None
+        logits = state.apply_fn(
+            {"params": state.params}, batch["input_ids"], deterministic=True,
+            segment_ids=segment_ids,
+        )
+        loss = lm_loss(logits, batch["input_ids"], mask=batch.get("mask"), segment_ids=segment_ids)
+        return {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return jax.jit(eval_step)
+
+
 def make_classifier_eval_step(input_signature: Tuple[str, ...] = ("inputs",)) -> Callable:
     def eval_step(state: TrainState, batch: Dict[str, jax.Array]):
         logits = state.apply_fn(

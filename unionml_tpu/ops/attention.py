@@ -60,14 +60,23 @@ def xla_attention(
         causal_mask = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool))
         logits = jnp.where(causal_mask[None, None], logits, _NEG_INF)
     if segment_ids is not None:
-        logits = jnp.where(segment_mask(segment_ids), logits, _NEG_INF)
+        # sliced per axis so cross-length (seq_q != seq_k) calls mask correctly,
+        # matching the pallas path's _segment_arrays slicing
+        ids_q = segment_ids[:, :seq_q]
+        ids_k = segment_ids[:, :seq_k]
+        valid = (
+            (ids_q[:, :, None] == ids_k[:, None, :])
+            & (ids_q > 0)[:, :, None]
+            & (ids_k > 0)[:, None, :]
+        )
+        logits = jnp.where(valid[:, None], logits, _NEG_INF)
     if mask is not None:
         logits = jnp.where(mask, logits, _NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     # fully-masked rows (padding in a packed batch) softmax to uniform garbage;
     # zero them so packed outputs match the per-sequence reference exactly
     if segment_ids is not None:
-        weights = jnp.where((segment_ids > 0)[:, None, :, None], weights, 0.0)
+        weights = jnp.where((ids_q > 0)[:, None, :, None], weights, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
 
 
@@ -83,6 +92,7 @@ def _flash_kernel(
     sm_scale: float,
     block_q: int,
     packed: bool = False,
+    heads: int = 1,
 ):
     """One (batch*head, q_block) program: stream KV blocks with an online softmax.
 
@@ -92,15 +102,18 @@ def _flash_kernel(
     K positions >= kv_len contribute nothing. When pallas passes a second output
     ref (``lse_ref``), the per-row logsumexp is written as the backward residual.
 
-    ``packed`` prepends two extra input refs carrying packed segment ids in
+    ``packed`` prepends four extra input refs: packed segment ids in
     Mosaic-friendly layouts — (1, block_q, 1) and (1, 1, seq_k) blocks of the
     (batch, seq, 1) / (batch, 1, seq) id arrays — adding the blockwise
-    same-segment constraint that packing needs WITHOUT a dense (seq, seq) mask.
+    same-segment constraint that packing needs WITHOUT a dense (seq, seq) mask,
+    plus the rank-1 SMEM block-skip bounds from :func:`_segment_block_bounds`
+    (this q block's live KV range), so cross-segment KV blocks are never even
+    loaded — per-row work is O(sum seg_len^2), not O(seq^2).
     """
     if packed:
-        seg_q_ref, seg_k_ref, o_ref, *maybe_lse = rest
+        seg_q_ref, seg_k_ref, kvb_start_ref, kvb_stop_ref, o_ref, *maybe_lse = rest
     else:
-        seg_q_ref = seg_k_ref = None
+        seg_q_ref = seg_k_ref = kvb_start_ref = kvb_stop_ref = None
         o_ref, *maybe_lse = rest
     lse_ref = maybe_lse[0] if maybe_lse else None
 
@@ -147,11 +160,20 @@ def _flash_kernel(
         row_sum = row_sum * correction + jnp.sum(probs, axis=-1, keepdims=True)
         return acc, new_max, row_sum
 
-    # bound the scan: skip fully-masked KV blocks (padding tail; causal upper triangle)
+    # bound the scan: skip fully-masked KV blocks (padding tail; causal upper
+    # triangle; packed: everything outside this q block's own segments)
+    first_block = jnp.int32(0)
     last_block = jnp.minimum(num_k_blocks, pl.cdiv(kv_len, block_k))
     if causal:
         last_block = jnp.minimum(last_block, pl.cdiv((q_index + 1) * block_q, block_k))
-    acc, row_max, row_sum = jax.lax.fori_loop(0, last_block, body, (acc, row_max, row_sum))
+    if packed:
+        num_q_blocks = pl.num_programs(1)
+        bounds_row = (pl.program_id(0) // heads) * num_q_blocks + q_index
+        first_block = jnp.maximum(first_block, kvb_start_ref[bounds_row])
+        last_block = jnp.minimum(last_block, kvb_stop_ref[bounds_row])
+    acc, row_max, row_sum = jax.lax.fori_loop(
+        first_block, last_block, body, (acc, row_max, row_sum)
+    )
     # fully-masked rows (packed padding) carry acc == row_sum == 0 — the masked probs
     # above guarantee it — so the guarded divide emits the zeros the XLA reference
     # and the ring kernel produce for such rows
@@ -185,6 +207,51 @@ def _segment_arrays(segment_ids: jax.Array, seq_q: int, seq_k: int):
     positions = jnp.arange(seq_k, dtype=jnp.int32)[None, :]
     kv_lens = jnp.max(jnp.where(ids[:, :seq_k] > 0, positions + 1, 0), axis=-1)
     return seg_q3, seg_k3, kv_lens
+
+
+def _segment_block_bounds(segment_ids, block: int, other_block: int):
+    """Per-chunk live range of the other axis — the packed kernels' block-skip map.
+
+    ``segment_ids`` is a ``(block_axis_ids, other_axis_ids)`` pair — e.g. the
+    q-side slice and the kv-side slice of the packed id array; lengths may
+    differ (cross-length attention slices both from one array). A chunk of
+    ``block`` positions on the block axis may only interact with other-axis
+    positions of the segments it contains (plus nothing, for pure padding). For
+    each row and chunk this computes the union of its segments' TRUE other-axis
+    extents — scatter-min/max over segment IDS, not run boundaries, so rows
+    that reuse an id non-contiguously get the full (conservative) extent and
+    stay exact — and returns ``(start, stop)`` int32 arrays of shape
+    (batch, s_block // block), in units of ``other_block``,
+    flattened-rank-1-ready for SMEM. Empty chunks (and ids absent from the
+    other axis) get start >= stop (the fori_loop runs zero iterations).
+    Out-of-range ids clamp into one shared bucket: merged extents are
+    supersets, and in-block masking keeps supersets exact.
+
+    This is where packing pays on TPU: total kernel work drops from
+    O(seq^2) to O(sum_i seg_len_i^2) per row — the XLA path cannot skip, it
+    materializes the dense mask and computes every pair.
+    """
+    block_ids, other_ids = (x.astype(jnp.int32) for x in segment_ids)
+    batch, s_other = other_ids.shape
+    s_block = block_ids.shape[1]
+    cap = max(s_block, s_other)  # shared clip bucket for out-of-range ids
+    pos_o = jnp.broadcast_to(jnp.arange(s_other, dtype=jnp.int32)[None, :], other_ids.shape)
+    rows_o = jnp.broadcast_to(jnp.arange(batch, dtype=jnp.int32)[:, None], other_ids.shape)
+    safe_o = jnp.clip(other_ids, 0, cap)
+    first_of_id = jnp.full((batch, cap + 1), s_other, jnp.int32).at[rows_o, safe_o].min(pos_o)
+    end_of_id = jnp.zeros((batch, cap + 1), jnp.int32).at[rows_o, safe_o].max(pos_o + 1)
+    safe_b = jnp.clip(block_ids, 0, cap)
+    seg_start = jnp.take_along_axis(first_of_id, safe_b, axis=1)  # (batch, s_block)
+    seg_end = jnp.take_along_axis(end_of_id, safe_b, axis=1)
+    live = block_ids > 0
+    n_chunks = s_block // block
+    chunk_start = jnp.min(
+        jnp.where(live, seg_start, s_other).reshape(batch, n_chunks, block), axis=2
+    )
+    chunk_end = jnp.max(jnp.where(live, seg_end, 0).reshape(batch, n_chunks, block), axis=2)
+    start_blocks = chunk_start // other_block
+    stop_blocks = -(-chunk_end // other_block)  # cdiv
+    return start_blocks.reshape(-1), stop_blocks.reshape(-1)
 
 
 def _flash_forward(
@@ -229,6 +296,7 @@ def _flash_forward(
         sm_scale=sm_scale,
         block_q=block_q,
         packed=packed,
+        heads=heads,
     )
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # whole kv_lens vector, unblocked
@@ -242,6 +310,14 @@ def _flash_forward(
         in_specs.append(pl.BlockSpec((1, block_q, 1), lambda b, i: (b // heads, i, 0)))
         in_specs.append(pl.BlockSpec((1, 1, seq_k), lambda b, i: (b // heads, 0, 0)))
         operands.extend([seg_q3, seg_k3])
+        # per-q-block live KV ranges: rank-1 SMEM, row = batch * n_q_blocks + i
+        ids32 = segment_ids.astype(jnp.int32)
+        kvb_start, kvb_stop = _segment_block_bounds(
+            (ids32[:, :seq_q], ids32[:, :seq_k]), block_q, block_k
+        )
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.extend([kvb_start, kvb_stop])
     out_shape = [jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))]
     if return_residuals:
@@ -289,12 +365,13 @@ def _bwd_dq_kernel(
     sm_scale: float,
     block_q: int,
     packed: bool = False,
+    heads: int = 1,
 ):
     """dQ for one (batch*head, q_block): stream KV blocks, recompute probabilities."""
     if packed:
-        seg_q_ref, seg_k_ref, dq_ref = rest
+        seg_q_ref, seg_k_ref, kvb_start_ref, kvb_stop_ref, dq_ref = rest
     else:
-        seg_q_ref = seg_k_ref = None
+        seg_q_ref = seg_k_ref = kvb_start_ref = kvb_stop_ref = None
         (dq_ref,) = rest
     qs = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d); scores are pre-scaled
     do = do_ref[0].astype(jnp.float32)
@@ -328,10 +405,16 @@ def _bwd_dq_kernel(
             dscores, k_block, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
+    first_block = jnp.int32(0)
     last_block = jnp.minimum(num_k_blocks, pl.cdiv(kv_len, block_k))
     if causal:
         last_block = jnp.minimum(last_block, pl.cdiv((q_index + 1) * block_q, block_k))
-    dq = jax.lax.fori_loop(0, last_block, body, dq)
+    if packed:
+        # same per-q-block live KV range the forward used (see _segment_block_bounds)
+        bounds_row = (pl.program_id(0) // heads) * pl.num_programs(1) + q_index
+        first_block = jnp.maximum(first_block, kvb_start_ref[bounds_row])
+        last_block = jnp.minimum(last_block, kvb_stop_ref[bounds_row])
+    dq = jax.lax.fori_loop(first_block, last_block, body, dq)
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
@@ -350,12 +433,13 @@ def _bwd_dkv_kernel(
     sm_scale: float,
     block_k: int,
     packed: bool = False,
+    heads: int = 1,
 ):
     """dK/dV for one (batch*head, kv_block): stream Q blocks, recompute probabilities."""
     if packed:
-        seg_q_ref, seg_k_ref, dk_ref, dv_ref = rest
+        seg_q_ref, seg_k_ref, qb_start_ref, qb_stop_ref, dk_ref, dv_ref = rest
     else:
-        seg_q_ref = seg_k_ref = None
+        seg_q_ref = seg_k_ref = qb_start_ref = qb_stop_ref = None
         dk_ref, dv_ref = rest
     k_block = k_ref[0].astype(jnp.float32)  # (block_k, d)
     v_block = v_ref[0].astype(jnp.float32)
@@ -401,12 +485,18 @@ def _bwd_dkv_kernel(
 
     # causal: q blocks strictly above this kv block's diagonal contribute nothing;
     # kv blocks entirely beyond kv_len (padding tail) skip the whole scan; packed
-    # rows also skip the q padding suffix (zero segment ids => zero contribution)
-    first_block = (kv_index * block_k) // block_q if causal else 0
+    # rows additionally scan only the q blocks whose segments touch this kv block
+    # (transposed _segment_block_bounds map — same O(sum seg_len^2) economics as
+    # the forward)
+    first_block = (kv_index * block_k) // block_q if causal else jnp.int32(0)
     in_range = kv_index * block_k < kv_len
     num_live_q_blocks = (
         jnp.minimum(num_q_blocks, pl.cdiv(kv_len, block_q)) if packed else num_q_blocks
     )
+    if packed:
+        bounds_row = (pl.program_id(0) // heads) * pl.num_programs(1) + kv_index
+        first_block = jnp.maximum(first_block, qb_start_ref[bounds_row])
+        num_live_q_blocks = jnp.minimum(num_live_q_blocks, qb_stop_ref[bounds_row])
     last_block = jnp.where(in_range, num_live_q_blocks, first_block)
     dk, dv = jax.lax.fori_loop(first_block, last_block, body, (dk, dv))
     dk_ref[0] = dk.astype(dk_ref.dtype)
@@ -457,6 +547,23 @@ def _flash_backward(
         else []
     )
 
+    if packed:
+        ids32 = segment_ids.astype(jnp.int32)
+        kvb_start, kvb_stop = _segment_block_bounds(
+            (ids32[:, :seq_q], ids32[:, :seq_k]), block_q, block_k
+        )
+        qb_start, qb_stop = _segment_block_bounds(
+            (ids32[:, :seq_k], ids32[:, :seq_q]), block_k, block_q
+        )
+        dq_seg_operands = [*seg_operands, kvb_start, kvb_stop]
+        dq_seg_specs = seg_specs + [
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ]
+    else:
+        dq_seg_operands = seg_operands
+        dq_seg_specs = seg_specs
+
     dq_kernel = functools.partial(
         _bwd_dq_kernel,
         block_k=block_k,
@@ -465,6 +572,7 @@ def _flash_backward(
         sm_scale=sm_scale,
         block_q=block_q,
         packed=packed,
+        heads=heads,
     )
     dq = pl.pallas_call(
         dq_kernel,
@@ -478,7 +586,7 @@ def _flash_backward(
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ]
-        + seg_specs,
+        + dq_seg_specs,
         out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
         cost_estimate=pl.CostEstimate(
@@ -487,7 +595,7 @@ def _flash_backward(
             transcendentals=bh * seq_q * seq_k,
         ),
         interpret=interpret,
-    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3, *seg_operands)
+    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3, *dq_seg_operands)
 
     # the dkv grid iterates kv blocks: the key-segment operand is blocked, the
     # query-segment row streams whole
@@ -495,10 +603,13 @@ def _flash_backward(
         [
             pl.BlockSpec((1, seq_q, 1), lambda b, j: (b // heads, 0, 0)),
             pl.BlockSpec((1, 1, block_k), lambda b, j: (b // heads, 0, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # per-kv-block live q range
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ]
         if packed
         else []
     )
+    dkv_seg_operands = [*seg_operands, qb_start, qb_stop] if packed else seg_operands
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel,
         block_q=block_q,
@@ -507,6 +618,7 @@ def _flash_backward(
         sm_scale=sm_scale,
         block_k=block_k,
         packed=packed,
+        heads=heads,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -536,7 +648,7 @@ def _flash_backward(
             transcendentals=bh * seq_q * seq_k,
         ),
         interpret=interpret,
-    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3, *seg_operands)
+    )(kv_lens_bh, q3, k3, v3, do3, lse3, delta3, *dkv_seg_operands)
 
     unshape = lambda x, s: x.reshape(batch, heads, s, head_dim)
     return unshape(dq, seq_q), unshape(dk, seq_k), unshape(dv, seq_k)
