@@ -1,0 +1,64 @@
+"""TUNING_MEASURED.json overlay: distillation from sweep artifacts + table merge."""
+
+import importlib
+import json
+
+import pytest
+
+
+def test_distill_promotes_only_timing_valid_and_safe(tmp_path):
+    from tools.promote_tuning import distill
+
+    (tmp_path / "KERNEL_BENCH.json").write_text(json.dumps({
+        "timing_valid": True,
+        "results": {
+            "b8_h12_s128_d64": {"verdict": "use_xla", "best": {"block_q": 128, "block_k": 128, "fwdbwd_ms": 1.0, "max_err_vs_xla": 0.01}},
+            "b2_h12_s1024_d64": {"verdict": "use_pallas", "best": {"block_q": 512, "block_k": 512, "fwdbwd_ms": 0.9, "max_err_vs_xla": 0.05}},
+            "b2_h2_s256_d64": {"verdict": "use_pallas", "best": {"block_q": 256, "block_k": 256, "fwdbwd_ms": 0.5, "max_err_vs_xla": 0.9}},
+            "b2_h16_s512_d128": {"verdict": "use_pallas", "xla_fwdbwd_ms": 1.0, "best": {"block_q": 512, "block_k": 512, "fwdbwd_ms": 0.99, "max_err_vs_xla": 0.01}},
+        },
+    }))
+    # CPU correctness sweep must contribute nothing
+    (tmp_path / "PACKED_KERNEL_BENCH.json").write_text(json.dumps({
+        "timing_valid": False,
+        "results": {"b8_h12_s128_d64": {"verdict": "use_pallas"}},
+    }))
+    overlay = distill(tmp_path)
+    assert overlay["measured_impl"]["128,128,64"] == "xla"
+    assert overlay["measured_impl"]["1024,1024,64"] == "pallas"
+    # numerically-unsafe winner demoted to xla, and no block promotion for it
+    assert overlay["measured_impl"]["256,256,64"] == "xla"
+    # a <2% win is a tie: break toward the arbiter-validated default
+    assert overlay["measured_impl"]["512,512,128"] == "xla"
+    assert overlay["tuned_blocks"] == {"1024,1024,64": [512, 512]}
+    assert overlay["measured_packed_impl"] == {}
+
+
+def test_overlay_merges_into_tables(tmp_path, monkeypatch):
+    import unionml_tpu.ops.tuning as tuning
+
+    overlay = {
+        "measured_packed_impl": {"128,128,64": "pallas"},
+        "measured_impl": {"4096,4096,64": "pallas"},
+        "tuned_blocks": {"4096,4096,64": [512, 512]},
+    }
+    path = tmp_path / "TUNING_MEASURED.json"
+    path.write_text(json.dumps(overlay))
+
+    real_open = open
+
+    def fake_open(name, *args, **kwargs):
+        if str(name).endswith("TUNING_MEASURED.json"):
+            return real_open(path, *args, **kwargs)
+        return real_open(name, *args, **kwargs)
+
+    monkeypatch.setattr("builtins.open", fake_open)
+    try:
+        importlib.reload(tuning)
+        assert tuning.pick_packed_impl(128, 128, 64) == "pallas"
+        assert tuning.pick_packed_impl(512, 512, 64) == tuning.DEFAULT_PACKED_IMPL
+        assert tuning.pick_impl(4096, 4096, 64) == "pallas"
+        assert tuning.pick_block_sizes(4096, 4096, 64) == (512, 512)
+    finally:
+        monkeypatch.undo()
+        importlib.reload(tuning)  # restore the real tables for later tests

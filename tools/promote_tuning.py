@@ -1,0 +1,98 @@
+"""Distill kernel-sweep artifacts into the TUNING_MEASURED.json dispatch overlay.
+
+Run by ``tools/tpu_window.sh`` after the sweeps so a live hardware window
+promotes its winners into the auto-dispatch tables
+(:mod:`unionml_tpu.ops.tuning` loads the overlay at import). Only
+``timing_valid: true`` artifacts contribute — a CPU correctness sweep must
+never overwrite on-device verdicts.
+
+Artifact semantics: per shape, ``verdict`` says whether the pallas kernel beat
+XLA's fused attention end to end (fwd+bwd), and ``best`` carries the winning
+(block_q, block_k). Numerical-safety gate: a winner whose ``max_err_vs_xla``
+exceeds bf16-rounding scale is never promoted.
+"""
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+MAX_PROMOTABLE_ERR = 0.25  # bf16 attention outputs: observed rounding is ~0.06
+#: pallas must beat XLA by >2% to displace the default: single-window timings
+#: carry noise at that scale (TPU_PROBES.log), and a tie must break toward the
+#: path the end-to-end arbiter validated
+TIE_MARGIN = 0.98
+
+
+def _shape_key(name: str):
+    # sweep keys look like "b8_h12_s128_d64" (seq_q == seq_k in the sweeps)
+    parts = {p[0]: p[1:] for p in name.split("_") if p}
+    try:
+        seq, dim = int(parts["s"]), int(parts["d"])
+    except (KeyError, ValueError):
+        return None
+    return f"{seq},{seq},{dim}"
+
+
+def _load(path: pathlib.Path):
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not payload.get("timing_valid"):
+        return None
+    return payload.get("results", {})
+
+
+def distill(repo: pathlib.Path = REPO) -> dict:
+    overlay = {"measured_impl": {}, "measured_packed_impl": {}, "tuned_blocks": {}}
+    for artifact, table in (
+        ("KERNEL_BENCH.json", "measured_impl"),
+        ("PACKED_KERNEL_BENCH.json", "measured_packed_impl"),
+    ):
+        results = _load(repo / artifact)
+        if results is None:
+            continue
+        for name, entry in results.items():
+            key = _shape_key(name)
+            verdict = entry.get("verdict")
+            if key is None or verdict not in ("use_pallas", "use_xla", "pallas_failed_use_xla"):
+                continue
+            best = entry.get("best") or {}
+            err = best.get("max_err_vs_xla", 0.0)
+            if verdict == "use_pallas" and err > MAX_PROMOTABLE_ERR:
+                print(f"[promote] {artifact} {name}: pallas won but err={err}; keeping xla",
+                      file=sys.stderr)
+                verdict = "use_xla"
+            xla_ms = entry.get("xla_fwdbwd_ms")
+            if (
+                verdict == "use_pallas"
+                and xla_ms
+                and best.get("fwdbwd_ms", 0.0) > TIE_MARGIN * xla_ms
+            ):
+                print(f"[promote] {artifact} {name}: pallas within the tie margin "
+                      f"({best.get('fwdbwd_ms')} vs {xla_ms}ms); keeping xla",
+                      file=sys.stderr)
+                verdict = "use_xla"
+            overlay[table][key] = "pallas" if verdict == "use_pallas" else "xla"
+            if verdict == "use_pallas" and "block_q" in best:
+                overlay["tuned_blocks"][key] = [best["block_q"], best["block_k"]]
+    return overlay
+
+
+def main():
+    overlay = distill()
+    if not any(overlay.values()):
+        print("[promote] no timing-valid sweep artifacts; overlay unchanged", file=sys.stderr)
+        return
+    out = REPO / "TUNING_MEASURED.json"
+    with open(out, "w") as fh:
+        json.dump(overlay, fh, indent=2, sort_keys=True)
+    print(f"[promote] wrote {out}: "
+          f"{len(overlay['measured_impl'])} dense, "
+          f"{len(overlay['measured_packed_impl'])} packed verdicts", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,8 @@ fi
 run mfu 700 python bench_mfu.py
 run kernels 900 python bench_kernels.py
 run packed 600 python bench_kernels.py --packed
+# distill sweep winners into the dispatch overlay (no-op without timing-valid runs)
+run promote 60 python tools/promote_tuning.py
 run serving 420 python bench_serving.py --bert-base
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log
 exit 0
