@@ -30,8 +30,44 @@ def test_distill_promotes_only_timing_valid_and_safe(tmp_path):
     assert overlay["measured_impl"]["256,256,64"] == "xla"
     # a <2% win is a tie: break toward the arbiter-validated default
     assert overlay["measured_impl"]["512,512,128"] == "xla"
-    assert overlay["tuned_blocks"] == {"1024,1024,64": [512, 512]}
+    # measured best blocks promote for every numerically-safe shape (they serve
+    # the impl="pallas" escape hatch even where xla won), never for unsafe ones
+    assert overlay["tuned_blocks"] == {
+        "128,128,64": [128, 128],
+        "1024,1024,64": [512, 512],
+        "512,512,128": [512, 512],
+    }
     assert overlay["measured_packed_impl"] == {}
+    assert overlay["packed_tuned_blocks"] == {}
+
+
+def test_promote_merges_with_existing_overlay(tmp_path):
+    """A window with one failed sweep must not erase the other table's verdicts."""
+    import sys
+
+    sys.modules.pop("tools.promote_tuning", None)
+    from tools import promote_tuning
+
+    (tmp_path / "TUNING_MEASURED.json").write_text(json.dumps({
+        "measured_packed_impl": {"512,512,64": "pallas"},
+        "packed_tuned_blocks": {"512,512,64": [256, 256]},
+    }))
+    (tmp_path / "KERNEL_BENCH.json").write_text(json.dumps({
+        "timing_valid": True,
+        "results": {"b8_h12_s128_d64": {"verdict": "use_xla", "best": {
+            "block_q": 128, "block_k": 128, "fwdbwd_ms": 1.0, "max_err_vs_xla": 0.01}}},
+    }))
+    # no PACKED artifact at all this "window"
+    overlay = promote_tuning.distill(tmp_path)
+    import unittest.mock as mock
+
+    with mock.patch.object(promote_tuning, "REPO", tmp_path), \
+         mock.patch.object(promote_tuning, "distill", lambda: overlay):
+        promote_tuning.main()
+    merged = json.loads((tmp_path / "TUNING_MEASURED.json").read_text())
+    assert merged["measured_packed_impl"] == {"512,512,64": "pallas"}  # preserved
+    assert merged["packed_tuned_blocks"] == {"512,512,64": [256, 256]}
+    assert merged["measured_impl"] == {"128,128,64": "xla"}
 
 
 def test_overlay_merges_into_tables(tmp_path, monkeypatch):

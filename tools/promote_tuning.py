@@ -45,11 +45,14 @@ def _load(path: pathlib.Path):
     return payload.get("results", {})
 
 
+_TABLES = ("measured_impl", "measured_packed_impl", "tuned_blocks", "packed_tuned_blocks")
+
+
 def distill(repo: pathlib.Path = REPO) -> dict:
-    overlay = {"measured_impl": {}, "measured_packed_impl": {}, "tuned_blocks": {}}
-    for artifact, table in (
-        ("KERNEL_BENCH.json", "measured_impl"),
-        ("PACKED_KERNEL_BENCH.json", "measured_packed_impl"),
+    overlay = {name: {} for name in _TABLES}
+    for artifact, impl_table, blocks_table in (
+        ("KERNEL_BENCH.json", "measured_impl", "tuned_blocks"),
+        ("PACKED_KERNEL_BENCH.json", "measured_packed_impl", "packed_tuned_blocks"),
     ):
         results = _load(repo / artifact)
         if results is None:
@@ -75,9 +78,12 @@ def distill(repo: pathlib.Path = REPO) -> dict:
                       f"({best.get('fwdbwd_ms')} vs {xla_ms}ms); keeping xla",
                       file=sys.stderr)
                 verdict = "use_xla"
-            overlay[table][key] = "pallas" if verdict == "use_pallas" else "xla"
-            if verdict == "use_pallas" and "block_q" in best:
-                overlay["tuned_blocks"][key] = [best["block_q"], best["block_k"]]
+            overlay[impl_table][key] = "pallas" if verdict == "use_pallas" else "xla"
+            # measured best blocks serve impl="pallas" even where xla won the
+            # verdict (the documented escape hatch) — promote whenever the
+            # winner is numerically safe
+            if "block_q" in best and err <= MAX_PROMOTABLE_ERR:
+                overlay[blocks_table][key] = [best["block_q"], best["block_k"]]
     return overlay
 
 
@@ -87,11 +93,23 @@ def main():
         print("[promote] no timing-valid sweep artifacts; overlay unchanged", file=sys.stderr)
         return
     out = REPO / "TUNING_MEASURED.json"
+    # MERGE over the existing overlay: a window whose packed sweep failed (or ran
+    # CPU-only) must not erase on-device packed verdicts a previous window earned
+    merged = {name: {} for name in _TABLES}
+    try:
+        with open(out) as fh:
+            existing = json.load(fh)
+        for name in _TABLES:
+            merged[name].update(existing.get(name) or {})
+    except (OSError, ValueError):
+        pass
+    for name in _TABLES:
+        merged[name].update(overlay[name])
     with open(out, "w") as fh:
-        json.dump(overlay, fh, indent=2, sort_keys=True)
+        json.dump(merged, fh, indent=2, sort_keys=True)
     print(f"[promote] wrote {out}: "
-          f"{len(overlay['measured_impl'])} dense, "
-          f"{len(overlay['measured_packed_impl'])} packed verdicts", file=sys.stderr)
+          f"{len(merged['measured_impl'])} dense, "
+          f"{len(merged['measured_packed_impl'])} packed verdicts", file=sys.stderr)
 
 
 if __name__ == "__main__":

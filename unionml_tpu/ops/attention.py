@@ -686,18 +686,20 @@ def flash_attention(
     """
     if segment_ids is not None and kv_lens is not None:
         raise ValueError("segment_ids already encodes padding; pass kv_lens=None")
-    block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
+    block_q, block_k = _resolve_blocks(q, k, block_q, block_k, packed=segment_ids is not None)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     return _flash_forward(
         q, k, v, kv_lens, causal, scale, block_q, block_k, interpret, segment_ids=segment_ids
     )
 
 
-def _resolve_blocks(q, k, block_q, block_k):
+def _resolve_blocks(q, k, block_q, block_k, packed=False):
     if block_q is None or block_k is None:
         from unionml_tpu.ops.tuning import pick_block_sizes
 
-        tuned_q, tuned_k = pick_block_sizes(q.shape[-2], k.shape[-2], q.shape[-1])
+        tuned_q, tuned_k = pick_block_sizes(
+            q.shape[-2], k.shape[-2], q.shape[-1], packed=packed
+        )
         block_q = block_q if block_q is not None else tuned_q
         block_k = block_k if block_k is not None else tuned_k
     return block_q, block_k
@@ -706,7 +708,7 @@ def _resolve_blocks(q, k, block_q, block_k):
 def _flash_fwd(q, k, v, kv_lens, segment_ids, causal, sm_scale, block_q, block_k, interpret):
     if segment_ids is not None and kv_lens is not None:
         raise ValueError("segment_ids already encodes padding; pass kv_lens=None")
-    block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
+    block_q, block_k = _resolve_blocks(q, k, block_q, block_k, packed=segment_ids is not None)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     out, lse = _flash_forward(
         q,
@@ -728,7 +730,7 @@ def _flash_fwd(q, k, v, kv_lens, segment_ids, causal, sm_scale, block_q, block_k
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
     q, k, v, kv_lens, segment_ids, out, lse = residuals
-    block_q, block_k = _resolve_blocks(q, k, block_q, block_k)
+    block_q, block_k = _resolve_blocks(q, k, block_q, block_k, packed=segment_ids is not None)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     if lse is not None:
         dq, dk, dv = _flash_backward(

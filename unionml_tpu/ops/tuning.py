@@ -67,6 +67,12 @@ def pick_packed_impl(seq_q: int, seq_k: int, head_dim: int) -> str:
     """Measured attention backend for a packed (segment-ids) shape class."""
     return MEASURED_PACKED_IMPL.get((seq_q, seq_k, head_dim), DEFAULT_PACKED_IMPL)
 
+
+#: measured winners for PACKED (segment-ids) sweeps — kept separate from the
+#: dense table: the segment-masked, block-skipping kernel has its own optimal
+#: tiling, and a packed winner must never displace a dense one (or vice versa)
+PACKED_TUNED_BLOCKS: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
+
 #: candidate block edges for the sweep and the fallback ladder
 BLOCK_CANDIDATES: Tuple[int, ...] = (512, 256, 128, 64)
 
@@ -84,9 +90,19 @@ def _largest_dividing(seq: int, cap: int = 128) -> int:
     return cap
 
 
-def pick_block_sizes(seq_q: int, seq_k: int, head_dim: int) -> Tuple[int, int]:
-    """Block sizes for a flash-attention call: measured winner, else aligned default."""
-    tuned = TUNED_BLOCKS.get((seq_q, seq_k, head_dim))
+def pick_block_sizes(
+    seq_q: int, seq_k: int, head_dim: int, packed: bool = False
+) -> Tuple[int, int]:
+    """Block sizes for a flash-attention call: measured winner, else aligned default.
+
+    ``packed=True`` consults the packed sweep's winners first (falling back to
+    the dense winners, then the aligned ladder).
+    """
+    shape = (seq_q, seq_k, head_dim)
+    if packed:
+        tuned = PACKED_TUNED_BLOCKS.get(shape) or TUNED_BLOCKS.get(shape)
+    else:
+        tuned = TUNED_BLOCKS.get(shape)
     if tuned is not None:
         return tuned
     return _largest_dividing(seq_q), _largest_dividing(seq_k)
@@ -104,11 +120,24 @@ def _apply_measured_overlay() -> None:
     import json
     import os
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "TUNING_MEASURED.json")
-    try:
-        with open(path) as fh:
-            overlay = json.load(fh)
-    except (OSError, ValueError):
+    candidates = [os.environ.get("UNIONML_TUNING_OVERLAY", "")]
+    candidates.append(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "TUNING_MEASURED.json")
+    )
+    # pip-installed copies have no repo root two levels up; honor a checkout /
+    # working directory carrying the overlay (env var above is the explicit hook)
+    candidates.append(os.path.join(os.getcwd(), "TUNING_MEASURED.json"))
+    overlay = None
+    for path in candidates:
+        if not path:
+            continue
+        try:
+            with open(path) as fh:
+                overlay = json.load(fh)
+            break
+        except (OSError, ValueError):
+            continue
+    if overlay is None:
         return
 
     def parse(table):
@@ -126,6 +155,9 @@ def _apply_measured_overlay() -> None:
     MEASURED_PACKED_IMPL.update(parse(overlay.get("measured_packed_impl")))
     TUNED_BLOCKS.update(
         {shape: tuple(blocks) for shape, blocks in parse(overlay.get("tuned_blocks")).items()}
+    )
+    PACKED_TUNED_BLOCKS.update(
+        {shape: tuple(b) for shape, b in parse(overlay.get("packed_tuned_blocks")).items()}
     )
 
 

@@ -1,0 +1,129 @@
+"""Speculative-decoding serving facade for the ``/generate`` route.
+
+Wraps :func:`unionml_tpu.models.speculative.speculative_generate` behind the
+same asyncio contract as :class:`~unionml_tpu.serving.continuous.ContinuousBatcher`
+(``await generate(...)``, ``stream(...)``, ``close()``, an ``engine`` view for
+``/stats``), so an app serves a draft+target pair by passing this as the
+``generator``::
+
+    build_aiohttp_app(model, generator=SpeculativeBatcher(
+        target, target_vars, draft, draft_vars, gamma=4))
+
+Speculation is a LATENCY play, not a throughput play: each request decodes
+alone (the verify step is batch-1 — see ``models/speculative.py``), so requests
+serialize on one worker thread. For concurrent-throughput serving use the
+continuous-batching :class:`DecodeEngine` instead; measured on v5e, its decode
+lookahead is the throughput lever (TPU_PROBES.log 2026-07-29: 104.6 -> 1343.5
+tok/s at k=1 -> 32).
+"""
+
+import asyncio
+import threading
+from types import SimpleNamespace
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from unionml_tpu._logging import logger
+
+__all__ = ["SpeculativeBatcher"]
+
+
+class SpeculativeBatcher:
+    """Single-stream speculative generation behind the ContinuousBatcher contract."""
+
+    def __init__(
+        self,
+        target: Any,
+        target_variables: Any,
+        draft: Any,
+        draft_variables: Any,
+        *,
+        gamma: int = 4,
+        max_len: Optional[int] = None,
+    ) -> None:
+        self._target = target
+        self._target_variables = target_variables
+        self._draft = draft
+        self._draft_variables = draft_variables
+        self._gamma = int(gamma)
+        self._max_len = int(max_len or target.config.max_position_embeddings)
+        self._lock = threading.Lock()  # serializes device work across requests
+        self._closed = False
+        # persistent evolving key (same contract as DecodeEngine): identical
+        # sampled requests must NOT return identical completions unless the
+        # client pins an explicit seed
+        self._key = jax.random.PRNGKey(0)
+        # the /stats view; num_slots=1 states the single-stream design honestly.
+        # bucket_for is the route's prefill-validation hook: speculation prefills
+        # at the exact prompt length (no bucket ladder), so identity is correct
+        self.engine = SimpleNamespace(
+            num_slots=1, num_active=0, max_len=self._max_len, bucket_for=lambda n: n
+        )
+
+    # ------------------------------------------------------------------ request path
+
+    def _validate(self, prompt_ids: Sequence[int], max_new_tokens: int, sampling: dict):
+        if self._closed:
+            raise RuntimeError("SpeculativeBatcher is closed")
+        prompt = np.asarray(list(prompt_ids), dtype=np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt_ids must be a non-empty 1-D token list")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens + self._gamma + 1 > self._max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) + gamma slack "
+                f"({self._gamma + 1}) exceeds max_len ({self._max_len})"
+            )
+        if sampling.get("top_k") or sampling.get("top_p") not in (None, 1.0):
+            raise ValueError("speculative decoding supports temperature sampling only (no top_k/top_p)")
+        temperature = float(sampling.get("temperature", 0.0) or 0.0)
+        seed = sampling.get("seed")
+        return prompt, temperature, seed
+
+    def _run(self, prompt: np.ndarray, max_new_tokens: int, temperature: float, seed) -> List[int]:
+        from unionml_tpu.models.speculative import speculative_generate
+
+        with self._lock:
+            if seed is not None:
+                rng = jax.random.PRNGKey(int(seed))
+            else:
+                self._key, rng = jax.random.split(self._key)
+            self.engine.num_active = 1
+            try:
+                out = speculative_generate(
+                    self._target,
+                    self._target_variables,
+                    self._draft,
+                    self._draft_variables,
+                    jax.numpy.asarray(prompt)[None, :],
+                    max_new_tokens,
+                    gamma=self._gamma,
+                    temperature=temperature,
+                    rng=rng,
+                )
+            finally:
+                self.engine.num_active = 0
+        return [int(t) for t in np.asarray(out)[0, prompt.size :]]
+
+    async def generate(
+        self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling
+    ) -> List[int]:
+        prompt, temperature, seed = self._validate(prompt_ids, max_new_tokens, sampling)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self._run, prompt, max_new_tokens, temperature, seed
+        )
+
+    async def stream(self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling):
+        """Async iterator of tokens. Tokens arrive in one burst at completion:
+        speculation verifies whole proposal rounds, so there is no per-token
+        decode step to stream from (use the continuous engine for live streams)."""
+        for token in await self.generate(prompt_ids, max_new_tokens, **sampling):
+            yield token
+
+    def close(self) -> None:
+        self._closed = True
+        logger.info("SpeculativeBatcher closed.")
