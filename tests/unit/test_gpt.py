@@ -368,3 +368,40 @@ def test_gpt_sp_requires_mesh_and_generates_via_fallback():
     prompt = jnp.asarray(np.random.default_rng(1).integers(0, sp_config.vocab_size, (2, 8)))
     out = generate(GPTLMHeadModel(sp_config), variables, prompt, max_new_tokens=4)
     assert out.shape == (2, 12)
+
+
+def test_gpt_remat_grads_match_no_remat():
+    """GPTConfig.remat recomputes activations in the backward; gradients (and the
+    packed path) must match the non-remat config exactly."""
+    import numpy as np
+
+    from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel, init_params, lm_loss
+    from unionml_tpu.ops.packing import pack_sequences
+
+    base = dict(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+    plain_cfg = GPTConfig.tiny(**base)
+    remat_cfg = GPTConfig.tiny(remat=True, **base)
+    variables = init_params(plain_cfg, seq_len=16)
+    rng = np.random.default_rng(3)
+    packed = pack_sequences(
+        [rng.integers(1, plain_cfg.vocab_size, size=int(n)) for n in (9, 6, 12)], 16
+    )
+    ids = jnp.asarray(packed["input_ids"])
+    segs = jnp.asarray(packed["segment_ids"])
+
+    def grads(cfg):
+        def loss(params):
+            logits = GPTLMHeadModel(cfg).apply({"params": params}, ids, segment_ids=segs)
+            return lm_loss(logits, ids, segment_ids=segs)
+
+        return jax.grad(loss)(variables["params"])
+
+    g_plain, g_remat = grads(plain_cfg), grads(remat_cfg)
+    for a, b in zip(jax.tree_util.tree_leaves(g_plain), jax.tree_util.tree_leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # decode path is untouched by remat: cached generation still works
+    from unionml_tpu.models.gpt import generate
+
+    out = generate(GPTLMHeadModel(remat_cfg), variables, jnp.ones((1, 4), jnp.int32), 3, max_len=16)
+    assert out.shape == (1, 7)

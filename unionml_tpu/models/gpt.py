@@ -39,6 +39,10 @@ class GPTConfig:
     attention_impl: str = "auto"
     #: mesh carrying a "sequence" axis for ring/ulysses attention
     sp_mesh: Any = None
+    #: remat (jax.checkpoint) decoder blocks during TRAINING forwards: activations
+    #: recompute in the backward instead of living in HBM — the standard lever for
+    #: bigger batches/longer sequences (mirrors BertConfig.remat)
+    remat: bool = False
     #: sparse (mixture-of-experts) variant: every Nth block swaps its dense MLP for
     #: a routed :class:`unionml_tpu.models.moe.MoEMlp` (0 = fully dense). Router
     #: aux losses sow under "intermediates" — fold them into the training loss with
@@ -270,10 +274,15 @@ class GPTLMHeadModel(nn.Module):
         hidden = nn.Dropout(cfg.dropout)(hidden, deterministic=deterministic)
 
         new_cache: Dict[str, Any] = {}
+        block_cls = DecoderBlock
+        if cfg.remat and cache is None:
+            # training forwards only: decode steps are tiny and cache-carrying
+            # (deterministic is arg 4 counting self; it steers python control flow)
+            block_cls = nn.remat(DecoderBlock, static_argnums=(4,))
         for i in range(cfg.num_layers):
             layer_cache = None if cache is None else cache[f"layer_{i}"]
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
-            hidden, layer_cache = DecoderBlock(cfg, use_moe=use_moe, name=f"layer_{i}")(
+            hidden, layer_cache = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(
                 hidden, layer_cache, position, deterministic, pad_offsets, segment_ids
             )
             if layer_cache is not None:
