@@ -414,6 +414,8 @@ def fit_lm(
     checkpoint_every: int = 100,
     log_every: int = 50,
     seed: int = 0,
+    prefetch: bool = False,
+    prefetch_convert: Optional[Dict[str, str]] = None,
 ) -> FitResult:
     """Causal-LM training over RAGGED token sequences through the shared fit loop.
 
@@ -468,6 +470,8 @@ def fit_lm(
         checkpoint_every=checkpoint_every,
         log_every=log_every,
         seed=seed,
+        prefetch=prefetch,
+        prefetch_convert=prefetch_convert,
         step_fn=step_fn,
     )
 
