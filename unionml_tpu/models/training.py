@@ -69,11 +69,46 @@ def create_train_state(
     )
 
 
+def _accumulated_value_and_grad(loss_fn, params, batch, accum: int, dropout_rng, has_aux: bool):
+    """Microbatched value-and-grad: mean loss/aux/grads over ``accum`` slices.
+
+    ``loss_fn(params, microbatch, rng)`` runs per slice under ``lax.scan`` — peak
+    activation memory is one microbatch's, which is the point (pairs with remat
+    for memory-bound configs). Equal slice sizes make the mean-of-means exactly
+    the full-batch mean; the optimizer step matches the full-batch step up to
+    accumulation-order rounding (which adam's normalization amplifies for
+    near-zero gradients).
+    """
+
+    def reshape(x):
+        if x.shape[0] % accum:
+            raise ValueError(
+                f"grad_accum={accum} must divide the batch size ({x.shape[0]})"
+            )
+        return x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+
+    micro = jax.tree_util.tree_map(reshape, batch)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+
+    first = jax.tree_util.tree_map(lambda x: x[0], micro)
+    out_shapes = jax.eval_shape(grad_fn, params, first, dropout_rng)
+    zeros = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), out_shapes)
+
+    def body(carry, slice_and_index):
+        mb, index = slice_and_index
+        out = grad_fn(params, mb, jax.random.fold_in(dropout_rng, index))
+        return jax.tree_util.tree_map(jnp.add, carry, out), None
+
+    total, _ = jax.lax.scan(body, zeros, (micro, jnp.arange(accum)))
+    return jax.tree_util.tree_map(lambda x: x / accum, total)
+
+
 def make_classifier_train_step(
     mesh: Optional[Mesh] = None,
     param_spec: Any = None,
     input_signature: Tuple[str, ...] = ("inputs",),
     light_metrics: bool = False,
+    grad_accum: int = 1,
 ) -> Callable:
     """Build the compiled train step ``(state, batch) -> (state, metrics)``.
 
@@ -82,22 +117,33 @@ def make_classifier_train_step(
     (replicated when None); XLA inserts the grad all-reduce over ICI.
     ``light_metrics=True`` drops the ``grad_norm`` metric — in principle XLA CSEs it
     against the identical norm inside ``clip_by_global_norm``, and bench_mfu.py
-    measures whether that holds on real hardware.
+    measures whether that holds on real hardware. ``grad_accum=N`` splits each
+    batch into N sequential microbatches whose gradients average before the one
+    optimizer step — same objective, one-Nth the activation memory.
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
         dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
 
-        def loss_fn(params):
+        def loss_fn(params, mb, rng):
             logits = state.apply_fn(
                 {"params": params},
-                *[batch[k] for k in input_signature],
+                *[mb[k] for k in input_signature],
                 deterministic=False,
-                rngs={"dropout": dropout_rng},
+                rngs={"dropout": rng},
             )
-            return cross_entropy_and_accuracy(logits, batch["labels"])
+            return cross_entropy_and_accuracy(logits, mb["labels"])
 
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        if grad_accum > 1:
+            (loss, acc), grads = _accumulated_value_and_grad(
+                loss_fn, state.params, batch, grad_accum, dropout_rng, has_aux=True
+            )
+        else:
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch, dropout_rng
+            )
         new_state = state.apply_gradients(grads=grads)
         metrics = {"loss": loss, "accuracy": acc}
         if not light_metrics:
@@ -132,6 +178,7 @@ def make_lm_train_step(
     param_spec: Any = None,
     packed: bool = False,
     light_metrics: bool = False,
+    grad_accum: int = 1,
 ) -> Callable:
     """Compiled causal-LM train step ``(state, batch) -> (state, metrics)``.
 
@@ -141,28 +188,38 @@ def make_lm_train_step(
     segment, and the loss masks cross-segment transitions
     (:func:`unionml_tpu.models.gpt.lm_loss`). Unpacked batches may carry a
     ``"mask"`` (1 = real token) for plain right-padded LM training.
+    ``grad_accum=N`` microbatches each step (see
+    :func:`make_classifier_train_step`); note the packed per-row token counts
+    vary, so accumulated loss weights microbatches equally, not per-token.
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     from unionml_tpu.models.gpt import lm_loss
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
         dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
-        # strict lookup: a packed step fed a batch without segment ids must fail
-        # loudly, not silently train across packed-sequence boundaries
-        segment_ids = batch["segment_ids"] if packed else None
 
-        def loss_fn(params):
+        def loss_fn(params, mb, rng):
+            # strict lookup: a packed step fed a batch without segment ids must
+            # fail loudly, not silently train across packed-sequence boundaries
+            segment_ids = mb["segment_ids"] if packed else None
             logits = state.apply_fn(
                 {"params": params},
-                batch["input_ids"],
+                mb["input_ids"],
                 deterministic=False,
-                rngs={"dropout": dropout_rng},
+                rngs={"dropout": rng},
                 segment_ids=segment_ids,
             )
             return lm_loss(
-                logits, batch["input_ids"], mask=batch.get("mask"), segment_ids=segment_ids
+                logits, mb["input_ids"], mask=mb.get("mask"), segment_ids=segment_ids
             )
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        if grad_accum > 1:
+            loss, grads = _accumulated_value_and_grad(
+                loss_fn, state.params, batch, grad_accum, dropout_rng, has_aux=False
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch, dropout_rng)
         new_state = state.apply_gradients(grads=grads)
         metrics = {"loss": loss}
         if not light_metrics:
@@ -261,6 +318,7 @@ def fit(
     prefetch: bool = False,
     prefetch_convert: Optional[Dict[str, str]] = None,
     step_fn: Optional[Callable] = None,
+    grad_accum: int = 1,
 ) -> FitResult:
     """Run the compiled train loop; resumes from ``checkpoint_dir`` when present.
 
@@ -280,9 +338,15 @@ def fit(
     ``prefetch=True`` — silently skipping a requested conversion would be a
     correctness trap.
     """
+    if step_fn is not None and grad_accum != 1:
+        # silently ignoring a requested option is a correctness trap (same
+        # stance as prefetch_convert below): accumulation belongs to the step
+        # builder, so pass grad_accum to make_*_train_step instead
+        raise ValueError("grad_accum applies to the built-in step; pass it to your step builder")
     if step_fn is None:
         step_fn = make_classifier_train_step(
-            mesh=mesh, param_spec=param_spec, input_signature=input_signature
+            mesh=mesh, param_spec=param_spec, input_signature=input_signature,
+            grad_accum=grad_accum,
         )
 
     if prefetch_convert and not prefetch:
@@ -416,6 +480,7 @@ def fit_lm(
     seed: int = 0,
     prefetch: bool = False,
     prefetch_convert: Optional[Dict[str, str]] = None,
+    grad_accum: int = 1,
 ) -> FitResult:
     """Causal-LM training over RAGGED token sequences through the shared fit loop.
 
@@ -457,7 +522,9 @@ def fit_lm(
             logger.info("truncated %d sequences to seq_len=%d", truncated, seq_len)
         data = {"input_ids": input_ids, "mask": mask}
 
-    step_fn = make_lm_train_step(mesh=mesh, param_spec=param_spec, packed=pack)
+    step_fn = make_lm_train_step(
+        mesh=mesh, param_spec=param_spec, packed=pack, grad_accum=grad_accum
+    )
     return fit(
         state,
         data,
