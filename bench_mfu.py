@@ -86,6 +86,9 @@ def run_sweep():
             # ladder OOMs at become reachable, where MXU tiles are largest
             ("b256_remat", dict(batch=256, seq=128, config=dict(remat=True))),
             ("b512_remat", dict(batch=512, seq=128, config=dict(remat=True))),
+            # accumulation: biggest logical batch at one-quarter the activation
+            # memory — the fallback if plain b512_remat OOMs
+            ("b512_remat_accum4", dict(batch=512, seq=128, config=dict(remat=True), grad_accum=4)),
         ]
         config_cls = BertConfig.base
     else:  # CPU smoke of the harness itself
@@ -115,6 +118,7 @@ def run_sweep():
             step = make_classifier_train_step(
                 input_signature=("input_ids", "attention_mask") if spec.get("mask", True) else ("input_ids",),
                 light_metrics=spec.get("light_metrics", False),
+                grad_accum=spec.get("grad_accum", 1),
             )
             batch = {
                 "input_ids": jnp.asarray(
