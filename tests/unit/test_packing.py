@@ -371,3 +371,36 @@ def test_flash_packed_cross_length_matches_xla():
     g_x = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_f, g_x):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_fit_lm_moe_aux_losses_fold_into_objective():
+    """Sparse GPT through the public LM step: moe_aux=True adds the router
+    z/load-balancing losses to the objective (without it the router trains on
+    the LM gradient alone)."""
+    from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel, init_params
+    from unionml_tpu.models.training import create_train_state, make_lm_train_step
+
+    config = GPTConfig.tiny(
+        dropout=0.0, dtype=jnp.float32, attention_impl="xla",
+        moe_every=2, num_experts=4, moe_k=2,
+    )
+    model = GPTLMHeadModel(config)
+    variables = init_params(config, seq_len=16)
+    rng = np.random.default_rng(8)
+    packed = pack_sequences([rng.integers(1, config.vocab_size, size=7) for _ in range(8)], 16)
+    batch = {
+        "input_ids": jnp.asarray(packed["input_ids"]),
+        "segment_ids": jnp.asarray(packed["segment_ids"]),
+    }
+
+    def run(moe_aux):
+        fresh = jax.tree_util.tree_map(jnp.array, variables)
+        state = create_train_state(model, fresh, learning_rate=0.0)
+        _, metrics = make_lm_train_step(packed=True, moe_aux=moe_aux)(state, batch)
+        return metrics
+
+    with_aux = run(True)
+    without = run(False)
+    # aux losses are positive: the folded objective strictly exceeds the LM loss
+    assert float(with_aux["loss"]) > float(without["loss"])
+    assert np.isfinite(float(with_aux["grad_norm"]))

@@ -179,6 +179,7 @@ def make_lm_train_step(
     packed: bool = False,
     light_metrics: bool = False,
     grad_accum: int = 1,
+    moe_aux: bool = False,
 ) -> Callable:
     """Compiled causal-LM train step ``(state, batch) -> (state, metrics)``.
 
@@ -191,10 +192,15 @@ def make_lm_train_step(
     ``grad_accum=N`` microbatches each step (see
     :func:`make_classifier_train_step`); note the packed per-row token counts
     vary, so accumulated loss weights microbatches equally, not per-token.
+    ``moe_aux=True`` (sparse decoders) folds the sown router losses —
+    z-loss + load-balancing (:func:`unionml_tpu.models.moe.collect_aux_losses`)
+    — into the objective; without it a sparse model's router trains on the LM
+    gradient alone and is free to collapse onto few experts.
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     from unionml_tpu.models.gpt import lm_loss
+    from unionml_tpu.models.moe import collect_aux_losses
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
         dropout_rng = jax.random.fold_in(state.dropout_rng, state.step)
@@ -203,14 +209,26 @@ def make_lm_train_step(
             # strict lookup: a packed step fed a batch without segment ids must
             # fail loudly, not silently train across packed-sequence boundaries
             segment_ids = mb["segment_ids"] if packed else None
-            logits = state.apply_fn(
-                {"params": params},
-                mb["input_ids"],
-                deterministic=False,
-                rngs={"dropout": rng},
-                segment_ids=segment_ids,
-            )
-            return lm_loss(
+            if moe_aux:
+                logits, sown = state.apply_fn(
+                    {"params": params},
+                    mb["input_ids"],
+                    deterministic=False,
+                    rngs={"dropout": rng},
+                    segment_ids=segment_ids,
+                    mutable=["intermediates"],
+                )
+                aux = collect_aux_losses(sown["intermediates"])
+            else:
+                logits = state.apply_fn(
+                    {"params": params},
+                    mb["input_ids"],
+                    deterministic=False,
+                    rngs={"dropout": rng},
+                    segment_ids=segment_ids,
+                )
+                aux = 0.0
+            return aux + lm_loss(
                 logits, mb["input_ids"], mask=mb.get("mask"), segment_ids=segment_ids
             )
 
@@ -481,6 +499,7 @@ def fit_lm(
     prefetch: bool = False,
     prefetch_convert: Optional[Dict[str, str]] = None,
     grad_accum: int = 1,
+    moe_aux: bool = False,
 ) -> FitResult:
     """Causal-LM training over RAGGED token sequences through the shared fit loop.
 
@@ -523,7 +542,7 @@ def fit_lm(
         data = {"input_ids": input_ids, "mask": mask}
 
     step_fn = make_lm_train_step(
-        mesh=mesh, param_spec=param_spec, packed=pack, grad_accum=grad_accum
+        mesh=mesh, param_spec=param_spec, packed=pack, grad_accum=grad_accum, moe_aux=moe_aux
     )
     return fit(
         state,
