@@ -359,9 +359,68 @@ def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int =
         stop()
 
 
+def bench_speculative(iters: int = 20, max_new_tokens: int = 32, gamma: int = 4):
+    """Speculative vs plain single-stream /generate latency over real HTTP.
+
+    The latency claim speculation makes — fewer target forwards per token when
+    the draft's acceptance rate is high — measured end to end: same target
+    model served twice, once behind the continuous engine (lookahead 1, honest
+    single-stream baseline) and once behind ``SpeculativeBatcher``.
+    """
+    import json as _json
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GPTConfig, GPTLMHeadModel
+    from unionml_tpu.models.gpt import init_params
+    from unionml_tpu.serving import SpeculativeBatcher, build_aiohttp_app
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    if jax.default_backend() == "cpu":
+        t_cfg = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+        d_cfg = GPTConfig.tiny(
+            dropout=0.0, dtype=jnp.float32, attention_impl="xla", num_layers=1
+        )
+    else:  # GPT-2 small target, 2-layer draft sharing the config family
+        t_cfg = GPTConfig(dropout=0.0, dtype=jnp.bfloat16)
+        d_cfg = GPTConfig(dropout=0.0, dtype=jnp.bfloat16, num_layers=2)
+    target = GPTLMHeadModel(t_cfg)
+    t_vars = init_params(t_cfg, seq_len=16)
+    draft = GPTLMHeadModel(d_cfg)
+    d_vars = init_params(d_cfg, seq_len=16)
+    stub = types.SimpleNamespace(name="spec_bench_model", artifact=object())
+    payload = _json.dumps({"prompt_ids": [3, 1, 4, 1, 5], "max_new_tokens": max_new_tokens}).encode()
+
+    def measure(generator):
+        port, stop = _serve_app(
+            build_aiohttp_app(stub, resident=False, coalesce=False, generator=generator)
+        )
+        try:
+            return _measure(lambda: _post_json(port, "/generate", payload, timeout=300), iters=iters)
+        finally:
+            stop()
+
+    plain = measure(
+        lambda: DecodeEngine(target, t_vars, num_slots=1, max_len=128, prefill_buckets=(8,))
+    )
+    spec = measure(SpeculativeBatcher(target, t_vars, draft, d_vars, gamma=gamma, max_len=128))
+    return {
+        "max_new_tokens": max_new_tokens,
+        "gamma": gamma,
+        "plain_p50_ms": plain["p50_ms"],
+        "speculative_p50_ms": spec["p50_ms"],
+        "speedup_p50": round(plain["p50_ms"] / spec["p50_ms"], 3) if spec["p50_ms"] else None,
+        "iters": iters,
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--bert-base", action="store_true", help="bench full BERT-base (TPU)")
+    parser.add_argument("--speculative", action="store_true",
+                        help="also bench speculative vs plain single-stream generation")
     parser.add_argument("--out", default="SERVING_BENCH.json")
     args = parser.parse_args()
 
@@ -397,6 +456,14 @@ def main():
     print(json.dumps({"metric": "http_generate_p50_ms", "value": gen["p50_ms"], "unit": "ms",
                       "model": gen_name, "tokens_per_s_concurrent": gen["tokens_per_s_concurrent"],
                       "backend": backend}))
+
+    if args.speculative:
+        spec = bench_speculative()
+        results["models"]["speculative_vs_plain_http"] = spec
+        print(json.dumps({"metric": "speculative_generate_p50_ms",
+                          "value": spec["speculative_p50_ms"], "unit": "ms",
+                          "plain_p50_ms": spec["plain_p50_ms"],
+                          "speedup_p50": spec["speedup_p50"], "backend": backend}))
 
     with open(args.out, "w") as fh:
         json.dump(results, fh, indent=2)

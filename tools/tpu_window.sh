@@ -59,6 +59,6 @@ run kernels 900 python bench_kernels.py
 run packed 600 python bench_kernels.py --packed
 # distill sweep winners into the dispatch overlay (no-op without timing-valid runs)
 run promote 60 python tools/promote_tuning.py
-run serving 420 python bench_serving.py --bert-base
+run serving 540 python bench_serving.py --bert-base --speculative
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log
 exit 0
