@@ -1,0 +1,116 @@
+"""int8 weight-only decode at scale: does it pay at ~1B params?
+
+The round-2 lookahead probe found int8 neutral-to-slightly-slower at GPT-2
+small (124M): dequant overhead ~= weight-traffic savings
+(TPU_PROBES.log 2026-07-29T14:3xZ). The claim that it PAYS where decode is
+weight-bound — >=1B params — has never been measured. This harness builds a
+~1.3B-param randomly-initialized GPT (weight TRAFFIC is what decode time
+measures; weight values are irrelevant), runs the continuous engine's
+single-stream decode with and without ``quantize="int8"``, and records
+tokens/s for both into ``INT8_BENCH.json``.
+
+Run by tools/tpu_window.sh last (it is the battery's most expensive phase).
+CPU smoke uses the tiny config so the harness itself stays testable.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+)
+
+TOTAL_BUDGET_S = float(os.getenv("UNIONML_INT8_BUDGET", "540"))
+
+
+def run():
+    from __graft_entry__ import _honor_cpu_request
+
+    _honor_cpu_request()
+
+    import jax
+
+    try:
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:  # noqa: BLE001
+        pass
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel, init_params
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    if on_accel:
+        # ~1.3B params: 24 x 2048 with GPT-2 vocab (12*h^2*L + vocab*h)
+        config = GPTConfig(
+            vocab_size=50257, hidden_size=2048, num_layers=24, num_heads=16,
+            max_position_embeddings=256, dropout=0.0, dtype=jnp.bfloat16,
+        )
+        max_new, lookahead = 64, 8
+    else:
+        config = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+        max_new, lookahead = 16, 4
+
+    model = GPTLMHeadModel(config)
+    t0 = time.monotonic()
+    variables = init_params(config, seq_len=16)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(variables))
+    print(f"[int8] init {n_params/1e9:.2f}B params in {time.monotonic() - t0:.0f}s", file=sys.stderr)
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+
+    prompt = [3, 1, 4, 1, 5]
+    results = {"params_b": round(n_params / 1e9, 3), "max_new_tokens": max_new,
+               "lookahead": lookahead}
+    for mode in (None, "int8"):
+        name = mode or "bf16"
+        if time.monotonic() > deadline:
+            results[name] = {"error": "budget exhausted"}
+            continue
+        try:
+            engine = DecodeEngine(
+                model, variables, num_slots=1, max_len=128, prefill_buckets=(8,),
+                quantize=mode,
+            )
+            # warm: one full completion compiles prefill + decode
+            engine.generate(prompt, max_new, lookahead=lookahead)
+            t1 = time.perf_counter()
+            reps = 3
+            for _ in range(reps):
+                tokens = engine.generate(prompt, max_new, lookahead=lookahead)
+            elapsed = time.perf_counter() - t1
+            tok_s = reps * len(tokens) / elapsed
+            results[name] = {"tokens_per_s": round(tok_s, 1), "reps": reps}
+            print(f"[int8] {name}: {tok_s:.1f} tok/s", file=sys.stderr)
+        except Exception as exc:
+            results[name] = {"error": f"{type(exc).__name__}: {exc}"}
+            print(f"[int8] {name} failed: {exc}", file=sys.stderr)
+    if "tokens_per_s" in results.get("bf16", {}) and "tokens_per_s" in results.get("int8", {}):
+        results["int8_speedup"] = round(
+            results["int8"]["tokens_per_s"] / results["bf16"]["tokens_per_s"], 3
+        )
+    return results
+
+
+def main():
+    results = run()
+    import jax
+
+    payload = {
+        "metric": "int8_decode_at_scale",
+        "backend": jax.default_backend(),
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        **results,
+    }
+    if payload["backend"] != "cpu":
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "INT8_BENCH.json"), "w") as fh:
+            json.dump(payload, fh, indent=2)
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()

@@ -60,5 +60,7 @@ run packed 600 python bench_kernels.py --packed
 # distill sweep winners into the dispatch overlay (no-op without timing-valid runs)
 run promote 60 python tools/promote_tuning.py
 run serving 540 python bench_serving.py --bert-base --speculative
+# most expensive phase last: ~1.3B-param decode, bf16 vs int8 weight-only
+run int8 600 python bench_int8.py
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log
 exit 0
