@@ -242,6 +242,31 @@ def sweep_packed_tpu(shapes, candidates):
 
         ok = [row for row in table if "fwdbwd_ms" in row]
         best = min(ok, key=lambda r: r["fwdbwd_ms"]) if ok else None
+        # The verdict feeds promote_tuning's PERSISTENT dispatch overlay with a
+        # 2% tie margin, and merge semantics make a wrong "pallas" verdict
+        # sticky — so the coarse 3-iter sweep only ranks candidates, and the
+        # winner + XLA baseline are re-timed with enough samples that the
+        # promoted verdict clears the margin with headroom (ADVICE round 4).
+        if best is not None:
+            bq, bk = best["block_q"], best["block_k"]
+            xla_ms = _time(
+                scanned_bwd(lambda q, k, v: xla_attention(q, k, v, causal=True, segment_ids=seg)),
+                q, k, v, iters=8, reps=5,
+            ) / SCAN_N
+            best = dict(best)
+            best["fwdbwd_ms"] = round(
+                _time(
+                    scanned_bwd(
+                        lambda q, k, v: flash_attention(
+                            q, k, v, segment_ids=seg, causal=True, block_q=bq, block_k=bk
+                        )
+                    ),
+                    q, k, v, iters=8, reps=5,
+                ) / SCAN_N,
+                4,
+            )
+            print(f"[packed] seq={seq} verdict re-time: best bq={bq} bk={bk} "
+                  f"{best['fwdbwd_ms']:.3f}ms vs xla {xla_ms:.3f}ms", file=sys.stderr)
         results[f"b{batch}_h{heads}_s{seq}_d{head_dim}"] = {
             "xla_fwdbwd_ms": round(xla_ms, 4),
             "sweep": table,

@@ -380,3 +380,154 @@ def test_attention_rejects_segment_ids_with_kv_lens_consistently():
     for impl in ("auto", "xla", "pallas"):
         with pytest.raises(ValueError, match="segment_ids already encodes padding"):
             attention(q, k, v, segment_ids=seg, kv_lens=lens, impl=impl)
+
+
+# ---------------------------------------------------------------- round-4 ADVICE
+
+class _tuning_tables:
+    """Snapshot/restore the module-global dispatch tables around an overlay test."""
+
+    def __enter__(self):
+        from unionml_tpu.ops import tuning
+
+        self.tuning = tuning
+        self.saved = tuple(
+            dict(t) for t in (tuning.MEASURED_IMPL, tuning.MEASURED_PACKED_IMPL,
+                              tuning.TUNED_BLOCKS, tuning.PACKED_TUNED_BLOCKS)
+        )
+        return tuning
+
+    def __exit__(self, *exc):
+        t = self.tuning
+        for table, saved in zip(
+            (t.MEASURED_IMPL, t.MEASURED_PACKED_IMPL, t.TUNED_BLOCKS, t.PACKED_TUNED_BLOCKS),
+            self.saved,
+        ):
+            table.clear()
+            table.update(saved)
+
+
+def test_tuning_overlay_validates_entries(tmp_path, monkeypatch):
+    """Round-4 ADVICE #1: malformed overlay entries (unknown impl, non-int blocks)
+    are dropped at load, not deferred to a confusing in-trace failure."""
+    import json
+
+    overlay = {
+        "measured_impl": {"64,64,32": "pallas", "96,96,32": "cuda", "bad": "xla"},
+        "tuned_blocks": {"64,64,32": [64, 64], "96,96,32": ["128", 128], "80,80,32": [64]},
+        "measured_packed_impl": {"64,64,32": 7},
+        "packed_tuned_blocks": {"64,64,32": [True, 64]},
+    }
+    path = tmp_path / "overlay.json"
+    path.write_text(json.dumps(overlay))
+    monkeypatch.setenv("UNIONML_TUNING_OVERLAY", str(path))
+    with _tuning_tables() as tuning:
+        tuning._apply_measured_overlay()
+        assert tuning.MEASURED_IMPL[(64, 64, 32)] == "pallas"
+        assert (96, 96, 32) not in tuning.MEASURED_IMPL  # unknown impl dropped
+        assert tuning.TUNED_BLOCKS[(64, 64, 32)] == (64, 64)
+        assert (96, 96, 32) not in tuning.TUNED_BLOCKS  # string block dropped
+        assert (80, 80, 32) not in tuning.TUNED_BLOCKS  # wrong arity dropped
+        assert (64, 64, 32) not in tuning.MEASURED_PACKED_IMPL  # non-str impl dropped
+        assert (64, 64, 32) not in tuning.PACKED_TUNED_BLOCKS  # bool block dropped
+
+
+def test_tuning_overlay_non_dict_tables_ignored(tmp_path, monkeypatch):
+    """A table value of the wrong TYPE (list/str) must be ignored, not crash the
+    module import that _apply_measured_overlay runs under."""
+    import json
+
+    path = tmp_path / "overlay.json"
+    path.write_text(json.dumps({"tuned_blocks": [[64, 64]], "measured_impl": "xla"}))
+    monkeypatch.setenv("UNIONML_TUNING_OVERLAY", str(path))
+    with _tuning_tables() as tuning:
+        before = dict(tuning.TUNED_BLOCKS)
+        tuning._apply_measured_overlay()  # must not raise
+        assert tuning.TUNED_BLOCKS == before
+
+
+def test_tuning_overlay_ignores_cwd(tmp_path, monkeypatch):
+    """Round-4 ADVICE #1: a TUNING_MEASURED.json in an unrelated working directory
+    must not alter kernel dispatch (only the env var and the repo root load)."""
+    import json
+
+    poison = {"measured_impl": {"999,999,999": "pallas"}}
+    (tmp_path / "TUNING_MEASURED.json").write_text(json.dumps(poison))
+    monkeypatch.delenv("UNIONML_TUNING_OVERLAY", raising=False)
+    monkeypatch.chdir(tmp_path)
+    with _tuning_tables() as tuning:
+        tuning._apply_measured_overlay()
+        assert (999, 999, 999) not in tuning.MEASURED_IMPL
+
+
+def test_flash_packed_bwd_seq_q_longer_than_kv():
+    """Round-4 ADVICE #2: with seq_q > seq_k, live q rows beyond kv_len must still
+    contribute to dk/dv — the legacy cdiv(kv_len, block_q) bound measured KV
+    length in Q-block units and skipped those q blocks."""
+    from unionml_tpu.ops.attention import flash_attention
+
+    rng = np.random.default_rng(29)
+    q = jnp.asarray(rng.normal(size=(2, 2, 128, 32)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 2, 64, 32)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 2, 64, 32)), jnp.float32)
+    # duplicate segment ids: q rows 64..127 (seg 2) live beyond kv_len == 64
+    segs = np.zeros((2, 128), np.int32)
+    segs[:, :40] = 1
+    segs[:, 40:128] = 2
+    segs = jnp.asarray(segs)
+    blocks = dict(block_q=16, block_k=16)
+
+    def loss_flash(a, b, c):
+        return jnp.sum(flash_attention(a, b, c, segment_ids=segs, interpret=True, **blocks) ** 2)
+
+    def loss_xla(a, b, c):
+        return jnp.sum(xla_attention(a, b, c, segment_ids=segs) ** 2)
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_x = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), g_f, g_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   err_msg=f"{name} mismatch")
+
+
+def test_resident_device_latency_concurrent_first_calls_excluded():
+    """Round-4 ADVICE #3: two requests racing on a NEW shape both pay (or wait on)
+    the same trace+compile — neither may record into the steady-state window."""
+    import threading
+
+    from unionml_tpu.serving.resident import ResidentPredictor
+
+    from .test_resident import _build_tokenized_model
+
+    model = _build_tokenized_model()
+    resident = ResidentPredictor(model, buckets=(4,), warmup=False)
+    resident.setup()
+    assert resident._compiled is not None
+
+    inner = resident._compiled
+    barrier = threading.Barrier(2, timeout=30)
+
+    def gated(*args, **kwargs):
+        barrier.wait()  # both requests are in-flight before either completes
+        return inner(*args, **kwargs)
+
+    resident._compiled = gated
+    rows = [{"len": 3}]
+    errors = []
+
+    def run():
+        try:
+            resident.predict(features=rows)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert list(resident._device_times_ms) == []  # both cold calls excluded
+    resident._compiled = inner
+    resident.predict(features=rows)  # warm-at-start: this one records
+    assert len(resident._device_times_ms) == 1

@@ -490,10 +490,11 @@ def _bwd_dkv_kernel(
     # the forward)
     first_block = (kv_index * block_k) // block_q if causal else jnp.int32(0)
     in_range = kv_index * block_k < kv_len
-    num_live_q_blocks = (
-        jnp.minimum(num_q_blocks, pl.cdiv(kv_len, block_q)) if packed else num_q_blocks
-    )
+    num_live_q_blocks = num_q_blocks
     if packed:
+        # the transposed _segment_block_bounds map is the exact live-q-block
+        # bound; a kv_len-derived bound would measure KV length in Q-block
+        # units and drop dk/dv rows whenever seq_q > seq_k (ADVICE round 4)
         bounds_row = (pl.program_id(0) // heads) * pl.num_programs(1) + kv_index
         first_block = jnp.maximum(first_block, qb_start_ref[bounds_row])
         num_live_q_blocks = jnp.minimum(num_live_q_blocks, qb_stop_ref[bounds_row])

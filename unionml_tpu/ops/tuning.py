@@ -120,13 +120,13 @@ def _apply_measured_overlay() -> None:
     import json
     import os
 
-    candidates = [os.environ.get("UNIONML_TUNING_OVERLAY", "")]
-    candidates.append(
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "TUNING_MEASURED.json")
-    )
-    # pip-installed copies have no repo root two levels up; honor a checkout /
-    # working directory carrying the overlay (env var above is the explicit hook)
-    candidates.append(os.path.join(os.getcwd(), "TUNING_MEASURED.json"))
+    # Explicit env-var hook first, then the repo root (developer checkout). No
+    # cwd fallback: a stale TUNING_MEASURED.json in an unrelated working
+    # directory must not silently alter kernel dispatch (ADVICE round 4).
+    candidates = [
+        os.environ.get("UNIONML_TUNING_OVERLAY", ""),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "TUNING_MEASURED.json"),
+    ]
     overlay = None
     for path in candidates:
         if not path:
@@ -137,28 +137,46 @@ def _apply_measured_overlay() -> None:
             break
         except (OSError, ValueError):
             continue
-    if overlay is None:
+    if not isinstance(overlay, dict):
         return
 
     def parse(table):
         out = {}
-        for key, value in (table or {}).items():
+        if not isinstance(table, dict):
+            return out
+        for key, value in table.items():
             try:
                 shape = tuple(int(x) for x in key.split(","))
-            except ValueError:
+            except (AttributeError, ValueError):
                 continue
             if len(shape) == 3:
                 out[shape] = value
         return out
 
-    MEASURED_IMPL.update(parse(overlay.get("measured_impl")))
-    MEASURED_PACKED_IMPL.update(parse(overlay.get("measured_packed_impl")))
-    TUNED_BLOCKS.update(
-        {shape: tuple(blocks) for shape, blocks in parse(overlay.get("tuned_blocks")).items()}
-    )
-    PACKED_TUNED_BLOCKS.update(
-        {shape: tuple(b) for shape, b in parse(overlay.get("packed_tuned_blocks")).items()}
-    )
+    def valid_impl(value):
+        return value in ("xla", "pallas")
+
+    def valid_blocks(value):
+        return (
+            isinstance(value, (list, tuple))
+            and len(value) == 2
+            and all(isinstance(b, int) and not isinstance(b, bool) and b > 0 for b in value)
+        )
+
+    # Malformed entries (wrong type, unknown impl, non-int blocks) are dropped
+    # here rather than surfacing later as a confusing in-trace failure.
+    for shape, impl in parse(overlay.get("measured_impl")).items():
+        if valid_impl(impl):
+            MEASURED_IMPL[shape] = impl
+    for shape, impl in parse(overlay.get("measured_packed_impl")).items():
+        if valid_impl(impl):
+            MEASURED_PACKED_IMPL[shape] = impl
+    for shape, blocks in parse(overlay.get("tuned_blocks")).items():
+        if valid_blocks(blocks):
+            TUNED_BLOCKS[shape] = tuple(blocks)
+    for shape, blocks in parse(overlay.get("packed_tuned_blocks")).items():
+        if valid_blocks(blocks):
+            PACKED_TUNED_BLOCKS[shape] = tuple(blocks)
 
 
 _apply_measured_overlay()

@@ -228,6 +228,12 @@ class ResidentPredictor:
             (getattr(leaf, "shape", None), str(getattr(leaf, "dtype", "")))
             for leaf in jax.tree_util.tree_leaves(padded)
         )
+        # warm status is snapshotted BEFORE dispatch: a request that starts while
+        # another request is still paying this shape's trace+compile waits on that
+        # same compile, so only requests that started after the shape was marked
+        # warm (at a prior call's completion) may record a steady-state sample
+        with self._device_times_lock:
+            was_warm = shape_sig in self._timed_shapes
         t0 = time.perf_counter()
         try:
             predictions = self._compiled(self._device_model_object, padded)
@@ -238,9 +244,9 @@ class ResidentPredictor:
         predictions = jax.device_get(predictions)  # the fetch is the device barrier
         elapsed_ms = (time.perf_counter() - t0) * 1e3
         with self._device_times_lock:
-            if shape_sig in self._timed_shapes:
+            if was_warm:
                 self._device_times_ms.append(elapsed_ms)
-            else:  # first call at this shape paid trace+compile: never record it
+            else:  # this call (and any concurrent peer) paid trace+compile: never record it
                 self._timed_shapes.add(shape_sig)
         # slice the padding off every batch-shaped leaf (predictor outputs may be pytrees)
         result = jax.tree_util.tree_map(
