@@ -446,6 +446,18 @@ def test_tuning_overlay_non_dict_tables_ignored(tmp_path, monkeypatch):
         assert tuning.TUNED_BLOCKS == before
 
 
+def test_tuning_overlay_non_dict_file_falls_through(tmp_path, monkeypatch):
+    """A top-level-non-dict env-var overlay (valid JSON, wrong type) must fall
+    through to the next candidate exactly like broken JSON syntax would."""
+    path = tmp_path / "overlay.json"
+    path.write_text("[]")
+    monkeypatch.setenv("UNIONML_TUNING_OVERLAY", str(path))
+    with _tuning_tables() as tuning:
+        tuning._apply_measured_overlay()  # falls through to the repo root overlay
+        # the repo-root TUNING_MEASURED.json still applies (it records xla verdicts)
+        assert tuning.MEASURED_IMPL.get((128, 128, 64)) == "xla"
+
+
 def test_tuning_overlay_ignores_cwd(tmp_path, monkeypatch):
     """Round-4 ADVICE #1: a TUNING_MEASURED.json in an unrelated working directory
     must not alter kernel dispatch (only the env var and the repo root load)."""

@@ -52,6 +52,12 @@ class GPTConfig:
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_router_noise: float = 0.0
+    #: "gshard" (default) or "a2a" — see :class:`unionml_tpu.models.moe.MoEMlp`.
+    #: "a2a" needs ``ep_mesh`` (an "expert" axis, optionally "data"): tokens are
+    #: sharded and only routed tokens move, via explicit all-to-alls over ICI.
+    moe_dispatch: str = "gshard"
+    #: mesh for expert-parallel MoE dispatch (required by moe_dispatch="a2a")
+    ep_mesh: Any = None
 
     @classmethod
     def tiny(cls, **overrides) -> "GPTConfig":
@@ -198,6 +204,8 @@ class DecoderBlock(nn.Module):
                 k=cfg.moe_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 router_noise=cfg.moe_router_noise,
+                dispatch=cfg.moe_dispatch,
+                mesh=cfg.ep_mesh,
                 dtype=cfg.dtype,
                 name="moe_mlp",
             )(normed, dropless=deterministic, deterministic=deterministic)

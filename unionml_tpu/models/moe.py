@@ -25,7 +25,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from unionml_tpu.parallel.ep import moe_apply_topk
+from unionml_tpu.parallel.ep import moe_apply_a2a, moe_apply_topk
 
 
 def router_z_loss(router_logits: jax.Array) -> jax.Array:
@@ -69,6 +69,15 @@ class MoEMlp(nn.Module):
     #: U[1-noise, 1+noise] when a "dropout" rng stream is supplied (i.e. during
     #: training); eval/generate calls carry no rng and stay deterministic.
     router_noise: float = 0.0
+    #: "gshard" routes via global one-hot dispatch einsums (XLA infers the
+    #: collectives from sharding constraints); "a2a" shards the tokens and moves
+    #: only routed tokens with explicit lax.all_to_all over the expert axis —
+    #: O(local_tokens x k x capacity_factor) per device, the pod-scale layout.
+    #: "a2a" requires ``mesh``; the dropless (inference) path is dense either way.
+    dispatch: str = "gshard"
+    #: token-sharding axis alongside "expert" for the a2a path (ignored when the
+    #: mesh doesn't carry it)
+    data_axis: str = "data"
 
     @nn.compact
     def __call__(self, x: jax.Array, dropless: bool = False, deterministic: bool = False) -> jax.Array:
@@ -113,15 +122,31 @@ class MoEMlp(nn.Module):
             w1, w2 = params
             return jax.nn.gelu(toks @ w1) @ w2
 
-        out = moe_apply_topk(
-            expert_fn,
-            (w_in, w_out),
-            tokens.astype(self.dtype),
-            gates.astype(self.dtype),
-            self.mesh,
-            k=self.k,
-            capacity_factor=None if dropless else self.capacity_factor,
-        )
+        if self.dispatch not in ("gshard", "a2a"):
+            raise ValueError(f"dispatch must be 'gshard' or 'a2a', got {self.dispatch!r}")
+        if self.dispatch == "a2a" and not dropless:
+            if self.mesh is None:
+                raise ValueError("dispatch='a2a' requires a mesh with an 'expert' axis")
+            out = moe_apply_a2a(
+                expert_fn,
+                (w_in, w_out),
+                tokens.astype(self.dtype),
+                gates.astype(self.dtype),
+                self.mesh,
+                k=self.k,
+                capacity_factor=self.capacity_factor,
+                data_axis=self.data_axis,
+            )
+        else:
+            out = moe_apply_topk(
+                expert_fn,
+                (w_in, w_out),
+                tokens.astype(self.dtype),
+                gates.astype(self.dtype),
+                self.mesh,
+                k=self.k,
+                capacity_factor=None if dropless else self.capacity_factor,
+            )
         return out.reshape(x.shape).astype(x.dtype)
 
 

@@ -154,21 +154,35 @@ def make_classifier_train_step(
 
 
 def _wrap_step(train_step: Callable, mesh: Optional[Mesh], param_spec: Any) -> Callable:
-    """jit a ``(state, batch) -> (state, metrics)`` step, mesh-sharded when given."""
+    """jit a ``(state, batch) -> (state, metrics)`` step, mesh-sharded when given.
+
+    With ``param_spec=None`` the state sharding is derived from the FIRST state the
+    step sees: leaves already laid out on this mesh keep their sharding (e.g. params
+    an internal ``shard_map`` committed to the expert axis during init — the
+    a2a-MoE case), everything else replicates — the plain-DP default.
+    """
     if mesh is None:
         return jax.jit(train_step, donate_argnums=(0,))
-    state_sharding = (
-        jax.tree_util.tree_map(
+    if param_spec is not None:
+        state_sharding = jax.tree_util.tree_map(
             lambda spec: NamedSharding(mesh, spec),
             param_spec,
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
-        if param_spec is not None
-        else replicated(mesh)
-    )
+        return jax.jit(
+            train_step,
+            in_shardings=(state_sharding, batch_sharding(mesh)),
+            donate_argnums=(0,),
+        )
+
+    # state sharding unspecified: committed leaves keep their layout (params an
+    # internal shard_map bound to the expert axis during init — the a2a-MoE case,
+    # whose layout also evolves onto the step's OUTPUT sharding after the first
+    # donated call), uncommitted leaves replicate onto the mesh — the plain-DP
+    # default an explicit replicated() used to force.
     return jax.jit(
         train_step,
-        in_shardings=(state_sharding, batch_sharding(mesh)),
+        in_shardings=(None, batch_sharding(mesh)),
         donate_argnums=(0,),
     )
 

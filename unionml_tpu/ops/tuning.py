@@ -133,11 +133,15 @@ def _apply_measured_overlay() -> None:
             continue
         try:
             with open(path) as fh:
-                overlay = json.load(fh)
-            break
+                loaded = json.load(fh)
         except (OSError, ValueError):
             continue
-    if not isinstance(overlay, dict):
+        # valid JSON of the wrong type is as malformed as broken syntax: fall
+        # through to the next candidate either way
+        if isinstance(loaded, dict):
+            overlay = loaded
+            break
+    if overlay is None:
         return
 
     def parse(table):
