@@ -98,10 +98,16 @@ def test_moe_mlp_a2a_dispatch_matches_gshard():
 
 
 def test_moe_mlp_a2a_requires_mesh():
-    layer = MoEMlp(num_experts=4, hidden_size=8, dispatch="a2a")
     x = jnp.ones((2, 4, 8))
+    layer = MoEMlp(num_experts=4, hidden_size=8, dispatch="a2a")
     with pytest.raises(ValueError, match="requires a mesh"):
         layer.init(jax.random.PRNGKey(0), x)
+    # a mesh WITHOUT an 'expert' axis gets the same clear error, not a KeyError
+    no_expert = MoEMlp(
+        num_experts=4, hidden_size=8, dispatch="a2a", mesh=make_mesh({"data": 8})
+    )
+    with pytest.raises(ValueError, match="requires a mesh with an 'expert' axis"):
+        no_expert.init(jax.random.PRNGKey(0), x)
 
 
 def test_moe_mlp_rejects_unknown_dispatch():

@@ -125,7 +125,7 @@ class MoEMlp(nn.Module):
         if self.dispatch not in ("gshard", "a2a"):
             raise ValueError(f"dispatch must be 'gshard' or 'a2a', got {self.dispatch!r}")
         if self.dispatch == "a2a" and not dropless:
-            if self.mesh is None:
+            if self.mesh is None or "expert" not in self.mesh.shape:
                 raise ValueError("dispatch='a2a' requires a mesh with an 'expert' axis")
             out = moe_apply_a2a(
                 expert_fn,

@@ -24,7 +24,6 @@ from unionml_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_axis_size,
     batch_sharding,
-    replicated,
     wrapped_row_indices,
 )
 from unionml_tpu.utils import hard_sync
@@ -114,7 +113,9 @@ def make_classifier_train_step(
 
     ``batch`` is a dict with ``input_signature`` keys + ``"labels"``. With a mesh, the
     batch is sharded over the data axis and the state laid out by ``param_spec``
-    (replicated when None); XLA inserts the grad all-reduce over ICI.
+    (when None, leaves already committed to this mesh keep their layout and the
+    rest replicate — see :func:`_wrap_step`); XLA inserts the grad all-reduce
+    over ICI.
     ``light_metrics=True`` drops the ``grad_norm`` metric — in principle XLA CSEs it
     against the identical norm inside ``clip_by_global_norm``, and bench_mfu.py
     measures whether that holds on real hardware. ``grad_accum=N`` splits each
