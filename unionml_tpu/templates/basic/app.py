@@ -1,15 +1,34 @@
-"""{{app_name}}: sklearn digits classifier on unionml-tpu (the quickstart)."""
+"""{{app_name}}: the unionml-tpu quickstart.
 
-from typing import List
+Digits classification with a from-scratch jax softmax regression: the trainer
+is a jit-compiled gradient loop, so the same app runs unchanged on CPU or a
+TPU chip. (For the framework's batteries-included MLP/fit() loop, see the
+``jax-digits`` template. Opaque model objects work too — the docs quickstart
+trains a classic sklearn estimator, and ``torch-digits`` a pytorch MLP.)
+"""
 
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
 import pandas as pd
 from sklearn.datasets import load_digits
-from sklearn.linear_model import LogisticRegression
 
 from unionml_tpu import Dataset, Model
 
 dataset = Dataset(name="{{app_name}}_dataset", test_size=0.2, shuffle=True, targets=["target"])
-model = Model(name="{{app_name}}", init=LogisticRegression, dataset=dataset)
+
+
+def init(scale: float = 0.01, seed: int = 0) -> Dict[str, jax.Array]:
+    """A (64 pixels -> 10 classes) softmax regression, as a plain param dict."""
+    key = jax.random.PRNGKey(seed)
+    return {
+        "w": scale * jax.random.normal(key, (64, 10), dtype=jnp.float32),
+        "b": jnp.zeros((10,), dtype=jnp.float32),
+    }
+
+
+model = Model(name="{{app_name}}", init=init, dataset=dataset)
 
 
 @dataset.reader
@@ -17,26 +36,56 @@ def reader() -> pd.DataFrame:
     return load_digits(as_frame=True).frame
 
 
+def _pixels(features: pd.DataFrame) -> jax.Array:
+    return jnp.asarray(features.to_numpy(), jnp.float32) / 16.0  # digits are 4-bit
+
+
+@jax.jit
+def _epoch(params: Dict[str, jax.Array], pixels, labels, learning_rate):
+    """One full-batch SGD step on the cross-entropy; compiled once, reused."""
+
+    def loss_fn(p):
+        logits = pixels @ p["w"] + p["b"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda p, g: p - learning_rate * g, params, grads)
+    return params, loss
+
+
 @model.trainer
-def trainer(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> LogisticRegression:
-    return estimator.fit(features, target.squeeze())
+def trainer(
+    params: Dict[str, jax.Array],
+    features: pd.DataFrame,
+    target: pd.DataFrame,
+    *,
+    learning_rate: float = 0.5,
+    num_epochs: int = 120,
+) -> Dict[str, jax.Array]:
+    pixels = _pixels(features)
+    labels = jnp.asarray(target.squeeze().to_numpy(), jnp.int32)
+    for _ in range(num_epochs):
+        params, loss = _epoch(params, pixels, labels, learning_rate)
+    return params
 
 
 @model.predictor
-def predictor(estimator: LogisticRegression, features: pd.DataFrame) -> List[float]:
-    return [float(x) for x in estimator.predict(features)]
+def predictor(params: Dict[str, jax.Array], features: pd.DataFrame) -> List[float]:
+    logits = _pixels(features) @ params["w"] + params["b"]
+    return [float(c) for c in jnp.argmax(logits, axis=-1)]
 
 
 @model.evaluator
-def evaluator(estimator: LogisticRegression, features: pd.DataFrame, target: pd.DataFrame) -> float:
-    from sklearn.metrics import accuracy_score
-
-    return float(accuracy_score(target.squeeze(), estimator.predict(features)))
+def evaluator(params: Dict[str, jax.Array], features: pd.DataFrame, target: pd.DataFrame) -> float:
+    guesses = jnp.asarray(predictor(params, features), jnp.int32)
+    truth = jnp.asarray(target.squeeze().to_numpy(), jnp.int32)
+    return float(jnp.mean(guesses == truth))
 
 
 if __name__ == "__main__":
-    model_object, metrics = model.train(hyperparameters={"C": 1.0, "max_iter": 5000})
+    params, metrics = model.train(hyperparameters={"scale": 0.01, "seed": 0})
     print(f"metrics: {metrics}")
     model.save("model.joblib")
-    features = load_digits(as_frame=True).frame.sample(5, random_state=42).drop(columns=["target"])
-    print(f"predictions: {model.predict(features=features.to_dict(orient='records'))}")
+    sample = load_digits(as_frame=True).frame.sample(5, random_state=42).drop(columns=["target"])
+    print(f"predictions: {model.predict(features=sample.to_dict(orient='records'))}")
