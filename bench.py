@@ -30,13 +30,15 @@ os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
 )
 
-#: round-2 v5e-1 measurement (examples/s): BERT-base bf16, batch 32, seq 128, pallas
-#: flash attention, steady-state with device-to-host fetch as the sync barrier
-#: (2026-07-29, TPU_PROBES.log). Later rounds report vs_baseline against it.
-#: PROVISIONAL pending re-baseline: the end-to-end arbiter measured ~1134 ex/s at
-#: B=64 with the now-default XLA attention dispatch (TPU_PROBES.log 17:1xZ); once a
-#: live driver-visible run confirms it, this constant moves to that number (the
-#: emitted ``baseline_examples_per_s`` field keeps the ratio self-describing).
+#: the framework's best CONFIRMED on-TPU measurement of this benchmark
+#: (examples/s). Seeded from the round-2 v5e-1 run (BERT-base bf16, B=32,
+#: seq 128, pallas dispatch, device-to-host fetch as the sync barrier —
+#: TPU_PROBES.log 2026-07-29); RATCHETED automatically by tools/rebaseline.py
+#: after each successful on-TPU bench.py run in the battery (the end-to-end
+#: arbiter suggests ~1134 ex/s at B=64 with the now-default XLA dispatch, so the
+#: first live battery should move this). vs_baseline is therefore
+#: current / best-confirmed-prior; the emitted ``baseline_examples_per_s`` field
+#: keeps the ratio self-describing either way.
 BASELINE_EXAMPLES_PER_S = 770.0
 
 #: hard ceiling on wall-clock before a zero result is emitted no matter what phase
@@ -331,9 +333,9 @@ def main():
         "value": round(value, 2),
         "unit": "examples/s",
         "vs_baseline": round(vs_baseline, 3),
-        # the denominator, so the ratio is self-describing (round-2 B=32 pallas
-        # measurement; provisional until a live run confirms the ~1134 ex/s
-        # XLA-dispatch number — see BASELINE_EXAMPLES_PER_S)
+        # the denominator, so the ratio is self-describing: the best confirmed
+        # prior on-TPU measurement, ratcheted by tools/rebaseline.py after each
+        # successful battery run — see BASELINE_EXAMPLES_PER_S
         "baseline_examples_per_s": BASELINE_EXAMPLES_PER_S,
     }
     if mfu is not None:

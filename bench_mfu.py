@@ -89,6 +89,10 @@ def run_sweep():
             # accumulation: biggest logical batch at one-quarter the activation
             # memory — the fallback if plain b512_remat OOMs
             ("b512_remat_accum4", dict(batch=512, seq=128, config=dict(remat=True), grad_accum=4)),
+            # bf16 adam first moment: halves mu HBM traffic in the optimizer step
+            ("b256_remat_bf16mu", dict(batch=256, seq=128, config=dict(remat=True), bf16_mu=True)),
+            # long-seq large-batch: biggest fused attention windows the chip holds
+            ("s512_b64_remat", dict(batch=64, seq=512, config=dict(remat=True))),
         ]
         config_cls = BertConfig.base
     else:  # CPU smoke of the harness itself
@@ -96,6 +100,7 @@ def run_sweep():
         variants = [
             ("b8_smoke", dict(batch=8, seq=128)),
             ("b8_gelu_tanh", dict(batch=8, seq=128, config=dict(gelu_approximate=True))),
+            ("b8_bf16mu", dict(batch=8, seq=128, bf16_mu=True)),
         ]
         config_cls = BertConfig.tiny
 
@@ -113,7 +118,8 @@ def run_sweep():
             model = BertForSequenceClassification(config)
             variables = init_params(config, seq_len=seq_len)
             state = create_train_state(
-                model, variables, learning_rate=2e-5, warmup_steps=10, total_steps=1000
+                model, variables, learning_rate=2e-5, warmup_steps=10, total_steps=1000,
+                mu_dtype=jnp.bfloat16 if spec.get("bf16_mu") else None,
             )
             step = make_classifier_train_step(
                 input_signature=("input_ids", "attention_mask") if spec.get("mask", True) else ("input_ids",),

@@ -86,13 +86,20 @@ def test_moe_mlp_a2a_dispatch_matches_gshard():
     gshard = MoEMlp(dispatch="gshard", **kwargs)
     a2a = MoEMlp(dispatch="a2a", **kwargs)
     params = gshard.init(jax.random.PRNGKey(1), x)  # identical param trees
-    out_g = jax.jit(lambda p, x: gshard.apply(p, x))(params, x)
-    out_a = jax.jit(lambda p, x: a2a.apply(p, x))(params, x)
-    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_g), atol=2e-5)
 
+    def out_and_grads(layer):
+        # forward + backward in ONE compile per layer (compile time dominates)
+        def fn(p):
+            out = layer.apply(p, x)
+            return jnp.sum(out ** 2), out
+
+        grads, out = jax.grad(fn, has_aux=True)(params)
+        return out, grads
+
+    out_g, g_g = out_and_grads(gshard)
+    out_a, g_a = out_and_grads(a2a)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_g), atol=2e-5)
     # gradients agree too (both paths are exact when nothing drops)
-    g_g = jax.grad(lambda p: jnp.sum(gshard.apply(p, x) ** 2))(params)
-    g_a = jax.grad(lambda p: jnp.sum(a2a.apply(p, x) ** 2))(params)
     for a, b in zip(jax.tree_util.tree_leaves(g_a), jax.tree_util.tree_leaves(g_g)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 

@@ -54,6 +54,9 @@ if ! run bench 600 python bench.py; then
   echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: bench failed; aborting battery (tunnel likely wedged)" >> TPU_PROBES.log
   exit 1
 fi
+# confirmed accelerator headline: ratchet bench.py's baseline to it so every later
+# run (incl. the driver's) reports vs_baseline against the best confirmed number
+run rebaseline 30 python tools/rebaseline.py /tmp/tpu_bench.out
 run mfu 700 python bench_mfu.py
 run kernels 900 python bench_kernels.py
 run packed 600 python bench_kernels.py --packed
