@@ -35,6 +35,9 @@ def main() -> int:
     except (OSError, IndexError, ValueError) as exc:
         print(f"[rebaseline] no usable bench output at {out_path}: {exc}", file=sys.stderr)
         return 1
+    if not isinstance(result, dict):
+        print(f"[rebaseline] last output line is not a JSON object: {line!r}", file=sys.stderr)
+        return 1
     value = float(result.get("value", 0.0))
     if result.get("metric") != "bert_base_finetune_throughput" or "mfu" not in result:
         print(f"[rebaseline] not an accelerator headline result: {line}", file=sys.stderr)
@@ -66,6 +69,9 @@ def main() -> int:
     try:
         with os.fdopen(fd, "w") as fh:
             fh.write(src)
+        # mkstemp creates 0600; the driver's own `python bench.py` may run as a
+        # different uid — preserve the original mode or it reads PermissionError
+        os.chmod(tmp, os.stat(BENCH).st_mode & 0o7777)
         os.replace(tmp, BENCH)
     except BaseException:
         try:

@@ -6,7 +6,13 @@ collectives — the TPU-native replacement for an NCCL/MPI backend (SURVEY.md §
 """
 
 from unionml_tpu.parallel.dp import batches, data_parallel_eval, data_parallel_step, pad_to_multiple
-from unionml_tpu.parallel.ep import expert_sharding, moe_apply, moe_apply_capacity, moe_apply_topk
+from unionml_tpu.parallel.ep import (
+    expert_sharding,
+    moe_apply,
+    moe_apply_a2a,
+    moe_apply_capacity,
+    moe_apply_topk,
+)
 from unionml_tpu.parallel.pp import (
     circular_superstage,
     pipeline_apply,
@@ -43,6 +49,7 @@ __all__ = [
     "expert_sharding",
     "logical_to_sharding",
     "moe_apply",
+    "moe_apply_a2a",
     "moe_apply_capacity",
     "moe_apply_topk",
     "circular_superstage",
