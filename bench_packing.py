@@ -1,0 +1,100 @@
+"""Host-side packing throughput: Python vs native first-fit packer.
+
+Packing runs once per training job over the whole corpus BEFORE the first step
+reaches the chip, entirely on the host — so unlike the kernel/MFU benches this
+one produces valid measurements on any machine. Emits one JSON line and writes
+PACKING_BENCH.json (both implementations' wall-clock + speedup + a parity
+checksum over a smaller slice).
+
+Corpus model: lognormal lengths clipped to [1, 2 * seq_len] — short-document
+heavy, the regime packing exists for (SURVEY.md packed-training rationale).
+"""
+
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+
+import numpy as np
+
+from unionml_tpu.native import native_available
+from unionml_tpu.ops.packing import pack_sequences, packing_efficiency
+
+
+def make_corpus(n_seqs: int, seq_len: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    lengths = np.clip(
+        rng.lognormal(mean=np.log(seq_len / 4), sigma=0.8, size=n_seqs).astype(np.int64),
+        1,
+        2 * seq_len,
+    )
+    return [rng.integers(1, 50_000, size=int(n)).astype(np.int32) for n in lengths]
+
+
+def timed(fn):
+    t0 = time.perf_counter()
+    out = fn()
+    return out, time.perf_counter() - t0
+
+
+def main():
+    n_seqs = int(os.getenv("UNIONML_PACK_BENCH_SEQS", "100000"))
+    seq_len = int(os.getenv("UNIONML_PACK_BENCH_SEQLEN", "512"))
+    corpus = make_corpus(n_seqs, seq_len)
+    total_tokens = int(sum(a.size for a in corpus))
+
+    results = {"n_seqs": n_seqs, "seq_len": seq_len, "total_tokens": total_tokens}
+
+    # parity gate on a slice (full-corpus double-pack would double the bench time)
+    check = corpus[:5000]
+    py_small = pack_sequences(check, seq_len, impl="python")
+    if native_available():
+        nat_small = pack_sequences(check, seq_len, impl="native")
+        for key in ("input_ids", "segment_ids", "positions"):
+            if not np.array_equal(py_small[key], nat_small[key]):
+                print(json.dumps({"metric": "packing_throughput", "error": f"parity {key}"}))
+                return 1
+
+    packed_py, py_s = timed(lambda: pack_sequences(corpus, seq_len, impl="python"))
+    results["python_s"] = round(py_s, 3)
+    results["python_seqs_per_s"] = round(n_seqs / py_s)
+    results["rows"] = int(packed_py["input_ids"].shape[0])
+    results["efficiency"] = round(packing_efficiency(packed_py["segment_ids"]), 4)
+
+    if native_available():
+        packed_nat, nat_s = timed(lambda: pack_sequences(corpus, seq_len, impl="native"))
+        assert packed_nat["input_ids"].shape == packed_py["input_ids"].shape
+        results["native_s"] = round(nat_s, 3)
+        results["native_seqs_per_s"] = round(n_seqs / nat_s)
+        results["speedup"] = round(py_s / nat_s, 1)
+        headline = results["native_seqs_per_s"]
+    else:
+        results["native_s"] = None
+        results["speedup"] = None
+        headline = results["python_seqs_per_s"]
+
+    payload = {
+        "bench": "sequence_packing_host",
+        "recorded_at": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+        **results,
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "PACKING_BENCH.json"), "w") as fh:
+        json.dump(payload, fh, indent=2)
+    print(
+        f"[bench_packing] python {py_s:.2f}s"
+        + (f" native {results['native_s']:.2f}s speedup {results['speedup']}x" if results["speedup"] else "")
+        + f" rows={results['rows']} efficiency={results['efficiency']}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "packing_throughput",
+        "value": headline,
+        "unit": "seqs/s",
+        "speedup_vs_python": results["speedup"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

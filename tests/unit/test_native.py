@@ -215,3 +215,42 @@ def test_prefetch_deferred_release_python_fallback():
         np.testing.assert_array_equal(views["x"], ref["x"])
         release()
     loader.close()
+
+
+def test_stale_library_missing_symbols_degrades_to_python(tmp_path, monkeypatch):
+    """A cached .so from an older package version (no upk_pack) with a fresh
+    mtime must degrade to the Python paths, not raise AttributeError."""
+    import subprocess
+    import sys
+    import time
+
+    import unionml_tpu.native as native_mod
+
+    # build a lib WITHOUT pack.cpp into an isolated UNIONML_TPU_HOME
+    home = tmp_path / "home"
+    lib_dir = home / "native"
+    lib_dir.mkdir(parents=True)
+    lib_path = lib_dir / "libunionml_prefetch.so"
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+         str(native_mod._SOURCES[0]), "-o", str(lib_path)],
+        check=True, capture_output=True,
+    )
+    future = time.time() + 3600  # newer than every source: the rebuild check passes it
+    import os
+    os.utime(lib_path, (future, future))
+
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(home))
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_build_failed", False)
+    try:
+        assert native_mod.load_native_library() is None  # degraded, no AttributeError
+        assert not native_mod.native_available()
+        # the public packing entrypoint still works via the Python path
+        from unionml_tpu.ops.packing import pack_sequences
+
+        out = pack_sequences([np.arange(1, 5)], 8, impl="native")
+        assert out["input_ids"].shape == (1, 8)
+    finally:
+        monkeypatch.setattr(native_mod, "_lib", None)
+        monkeypatch.setattr(native_mod, "_build_failed", False)

@@ -54,6 +54,10 @@ def test_refuses_cpu_and_foreign_results(workdir):
     assert run_tool(workdir, {"metric": "other", "value": 5000.0, "mfu": 0.4}).returncode == 1
     # valid JSON, wrong type
     assert run_tool(workdir, "[1, 2]").returncode == 1
+    # null / non-numeric value fields refuse cleanly, no traceback
+    for bad in (None, "n/a"):
+        proc = run_tool(workdir, {"metric": "bert_base_finetune_throughput", "value": bad, "mfu": 0.3})
+        assert proc.returncode == 1 and "Traceback" not in proc.stderr, proc.stderr
     # unreadable / non-JSON
     assert run_tool(workdir, "not json at all").returncode == 1
     assert baseline_of(workdir) == before

@@ -38,7 +38,11 @@ def main() -> int:
     if not isinstance(result, dict):
         print(f"[rebaseline] last output line is not a JSON object: {line!r}", file=sys.stderr)
         return 1
-    value = float(result.get("value", 0.0))
+    try:
+        value = float(result.get("value", 0.0))
+    except (TypeError, ValueError):
+        print(f"[rebaseline] non-numeric value field: {result.get('value')!r}", file=sys.stderr)
+        return 1
     if result.get("metric") != "bert_base_finetune_throughput" or "mfu" not in result:
         print(f"[rebaseline] not an accelerator headline result: {line}", file=sys.stderr)
         return 1

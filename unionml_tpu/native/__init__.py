@@ -16,7 +16,10 @@ import numpy as np
 
 from unionml_tpu._logging import logger
 
-_SOURCE = Path(__file__).parent / "prefetch.cpp"
+_SOURCES = (
+    Path(__file__).parent / "prefetch.cpp",
+    Path(__file__).parent / "pack.cpp",
+)
 _LIB_NAME = "libunionml_prefetch.so"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -43,7 +46,8 @@ def load_native_library() -> Optional[ctypes.CDLL]:
             return _lib
         lib_path = _build_dir() / _LIB_NAME
         try:
-            if not lib_path.exists() or lib_path.stat().st_mtime < _SOURCE.stat().st_mtime:
+            newest_src = max(src.stat().st_mtime for src in _SOURCES)
+            if not lib_path.exists() or lib_path.stat().st_mtime < newest_src:
                 lib_path.parent.mkdir(parents=True, exist_ok=True)
                 subprocess.run(
                     [
@@ -53,7 +57,7 @@ def load_native_library() -> Optional[ctypes.CDLL]:
                         "-fPIC",
                         "-pthread",
                         "-std=c++17",
-                        str(_SOURCE),
+                        *[str(src) for src in _SOURCES],
                         "-o",
                         str(lib_path),
                     ],
@@ -72,30 +76,105 @@ def load_native_library() -> Optional[ctypes.CDLL]:
             _build_failed = True
             return None
 
-        lib.upf_create.restype = ctypes.c_void_p
-        lib.upf_create.argtypes = [
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.c_long,
-            ctypes.c_long,
-        ]
-        lib.upf_start.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.c_long,
-            ctypes.c_long,
-            ctypes.c_long,
-            ctypes.c_long,
-            ctypes.POINTER(ctypes.c_void_p),
-        ]
-        lib.upf_next.restype = ctypes.c_long
-        lib.upf_next.argtypes = [ctypes.c_void_p]
-        lib.upf_release.argtypes = [ctypes.c_void_p, ctypes.c_long]
-        lib.upf_destroy.argtypes = [ctypes.c_void_p]
+        try:
+            lib.upf_create.restype = ctypes.c_void_p
+            lib.upf_create.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long,
+                ctypes.c_long,
+            ]
+            lib.upf_start.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_void_p),
+            ]
+            lib.upf_next.restype = ctypes.c_long
+            lib.upf_next.argtypes = [ctypes.c_void_p]
+            lib.upf_release.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.upf_destroy.argtypes = [ctypes.c_void_p]
+            lib.upk_pack.restype = ctypes.c_longlong
+            lib.upk_pack.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_longlong,
+                ctypes.c_longlong,
+                ctypes.c_int32,
+                ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+        except AttributeError as exc:
+            # a stale cached library from an older package version can lack newer
+            # symbols while carrying a fresher mtime than the sources; missing
+            # symbols must degrade to the Python paths like every other failure
+            logger.warning(
+                "Native library at %s is missing symbols (%s); falling back to Python. "
+                "Delete the file to force a rebuild.",
+                lib_path,
+                exc,
+            )
+            _build_failed = True
+            return None
         _lib = lib
         return _lib
+
+
+def pack_sequences_native(
+    flat_tokens: np.ndarray,
+    lengths: np.ndarray,
+    seq_len: int,
+    pad_id: int,
+    max_segments_per_row: int,
+) -> Optional[Dict[str, np.ndarray]]:
+    """First-fit packing through the native library; None when it is unavailable.
+
+    Inputs are pre-normalized by :func:`unionml_tpu.ops.packing.pack_sequences`
+    (empties filtered, overlong sequences truncated, tokens concatenated), so
+    this wrapper only allocates worst-case outputs and slices to the row count
+    the C side reports. Output arrays are byte-identical to the Python path's.
+    """
+    lib = load_native_library()
+    if lib is None:
+        return None
+    flat_tokens = np.ascontiguousarray(flat_tokens, dtype=np.int32)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    n_seqs = int(lengths.size)
+    max_rows = max(n_seqs, 1)
+    input_ids = np.empty((max_rows, seq_len), dtype=np.int32)
+    segment_ids = np.empty((max_rows, seq_len), dtype=np.int32)
+    positions = np.empty((max_rows, seq_len), dtype=np.int32)
+    as_i32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    n_rows = lib.upk_pack(
+        as_i32(flat_tokens),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n_seqs,
+        seq_len,
+        pad_id,
+        max_segments_per_row,
+        as_i32(input_ids),
+        as_i32(segment_ids),
+        as_i32(positions),
+    )
+    if n_rows < 0:
+        logger.warning("Native packer rejected inputs (rc=%d); using the Python path.", n_rows)
+        return None
+    # copy out of the worst-case buffers: a slice view (ascontiguousarray
+    # included — a contiguous leading slice IS contiguous) would keep all
+    # max_rows x seq_len x 3 arrays alive behind the (much smaller) result
+    shrink = (lambda a: a[:n_rows].copy()) if n_rows < max_rows else (lambda a: a)
+    return {
+        "input_ids": shrink(input_ids),
+        "segment_ids": shrink(segment_ids),
+        "positions": shrink(positions),
+    }
 
 
 def native_available() -> bool:

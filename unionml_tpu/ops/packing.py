@@ -23,12 +23,18 @@ import numpy as np
 __all__ = ["pack_sequences", "packing_efficiency"]
 
 
+#: corpora at least this large route to the native packer under impl="auto":
+#: below it the ctypes marshalling overhead rivals the Python loop's cost
+NATIVE_PACK_THRESHOLD = 2048
+
+
 def pack_sequences(
     sequences: Sequence[np.ndarray],
     seq_len: int,
     *,
     pad_id: int = 0,
     max_segments_per_row: int = 0,
+    impl: str = "auto",
 ) -> Dict[str, np.ndarray]:
     """Greedy first-fit packing of token sequences into fixed-length rows.
 
@@ -39,6 +45,13 @@ def pack_sequences(
     :param pad_id: token id written into padding slots.
     :param max_segments_per_row: cap on sequences per row (0 = unlimited) — some
         objectives want to bound the in-row mixing.
+    :param impl: ``"python"``, ``"native"`` (C++ via
+        :func:`unionml_tpu.native.pack_sequences_native`; falls back to Python
+        when the toolchain is absent), or ``"auto"`` — native for corpora of
+        ``NATIVE_PACK_THRESHOLD``+ sequences. Both paths run the SAME first-fit
+        algorithm and produce byte-identical outputs (pinned by tests); native
+        exists because the Python loop's O(n_seqs x n_rows) interpreter cost
+        dominates job start-up at corpus scale (bench_packing.py measures it).
     :returns: dict with ``input_ids`` (rows, seq_len) int32, ``segment_ids``
         (rows, seq_len) int32 (0 = padding), ``positions`` (rows, seq_len) int32
         (restarting per segment), and ``truncated`` (int) — how many input
@@ -46,9 +59,11 @@ def pack_sequences(
     """
     if seq_len <= 0:
         raise ValueError(f"seq_len must be positive, got {seq_len}")
-    rows: List[List[np.ndarray]] = []
-    row_space: List[int] = []
-    row_segments: List[int] = []
+    if impl not in ("auto", "python", "native"):
+        raise ValueError(f"impl must be 'auto', 'python', or 'native', got {impl!r}")
+
+    # normalize once, shared by both paths: drop empties, truncate overlong
+    arrays: List[np.ndarray] = []
     truncated = 0
     for seq in sequences:
         arr = np.asarray(seq).reshape(-1)
@@ -57,6 +72,28 @@ def pack_sequences(
         if arr.size > seq_len:
             arr = arr[:seq_len]
             truncated += 1
+        arrays.append(arr)
+
+    want_native = impl == "native" or (impl == "auto" and len(arrays) >= NATIVE_PACK_THRESHOLD)
+    if want_native:
+        from unionml_tpu.native import pack_sequences_native
+
+        lengths = np.asarray([a.size for a in arrays], dtype=np.int64)
+        flat = (
+            np.concatenate([a.astype(np.int32, copy=False) for a in arrays])
+            if arrays
+            else np.empty((0,), dtype=np.int32)
+        )
+        packed = pack_sequences_native(flat, lengths, seq_len, pad_id, max_segments_per_row)
+        if packed is not None:
+            packed["truncated"] = truncated
+            return packed
+        # no toolchain: fall through to the Python path
+
+    rows: List[List[np.ndarray]] = []
+    row_space: List[int] = []
+    row_segments: List[int] = []
+    for arr in arrays:
         placed = False
         # first-fit: the earliest row with room (and segment headroom)
         for i in range(len(rows)):
