@@ -18,6 +18,7 @@ numerically (forward and grads) in interpret mode, and the JSON says so.
 """
 
 import json
+import os
 import sys
 import time
 from datetime import datetime, timezone
@@ -419,11 +420,14 @@ def main():
         out_path, metric = "KERNEL_BENCH.json", "kernel_sweep"
 
     payload["recorded_at"] = datetime.now(timezone.utc).isoformat(timespec="seconds")
+    from bench import resolve_artifact_path
+
+    out_path = resolve_artifact_path(out_path, backend)
     with open(out_path, "w") as fh:
         json.dump(payload, fh, indent=2)
     print(json.dumps({"metric": metric, "backend": backend,
                       "timing_valid": payload["timing_valid"],
-                      "shapes": len(results)}))
+                      "shapes": len(results), "artifact": out_path}))
 
 
 if __name__ == "__main__":

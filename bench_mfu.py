@@ -161,16 +161,28 @@ def run_sweep():
 
 
 def main():
+    import jax
+
     results = run_sweep()
     payload = {
         "sweep": "bert_base_train_step_variants",
         "stamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": jax.default_backend(),
         "results": results,
     }
-    # write on any accelerator run — including all-errors sweeps, whose error
-    # entries + stamp must replace stale numbers rather than impersonate them
-    if any("mfu" in r or "error" in r for r in results):
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "MFU_SWEEP.json"), "w") as fh:
+    # accelerator runs own MFU_SWEEP.json — including all-errors sweeps, whose
+    # error entries + stamp must replace stale numbers rather than impersonate
+    # them; CPU smoke runs divert to the _cpu sibling (shared bench policy)
+    from bench import resolve_artifact_path
+
+    out_path = resolve_artifact_path(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "MFU_SWEEP.json"),
+        payload["backend"],
+    )
+    # accelerator artifact only when the sweep produced numbers or errors (an
+    # entirely-empty sweep must not blank a prior real one); _cpu always writes
+    if payload["backend"] == "cpu" or any("mfu" in r or "error" in r for r in results):
+        with open(out_path, "w") as fh:
             json.dump(payload, fh, indent=2)
     print(json.dumps(payload))
 

@@ -421,12 +421,21 @@ def main():
     parser.add_argument("--bert-base", action="store_true", help="bench full BERT-base (TPU)")
     parser.add_argument("--speculative", action="store_true",
                         help="also bench speculative vs plain single-stream generation")
-    parser.add_argument("--out", default="SERVING_BENCH.json")
+    parser.add_argument(
+        "--out",
+        default="SERVING_BENCH.json",
+        help="artifact path; CPU runs divert to a _cpu-suffixed sibling "
+        "(bench.resolve_artifact_path) so a local smoke run cannot overwrite "
+        "the committed TPU measurements BASELINE.md quotes",
+    )
     args = parser.parse_args()
 
     import jax
 
+    from bench import resolve_artifact_path
+
     backend = jax.default_backend()
+    args.out = resolve_artifact_path(args.out, backend)
     results = {
         "backend": backend,
         "recorded_at": datetime.now(timezone.utc).isoformat(timespec="seconds"),
