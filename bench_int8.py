@@ -106,9 +106,14 @@ def main():
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         **results,
     }
-    if payload["backend"] != "cpu":
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "INT8_BENCH.json"), "w") as fh:
-            json.dump(payload, fh, indent=2)
+    from bench import resolve_artifact_path
+
+    out_path = resolve_artifact_path(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "INT8_BENCH.json"),
+        payload["backend"],
+    )
+    with open(out_path, "w") as fh:
+        json.dump(payload, fh, indent=2)
     print(json.dumps(payload))
 
 

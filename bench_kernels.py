@@ -18,7 +18,6 @@ numerically (forward and grads) in interpret mode, and the JSON says so.
 """
 
 import json
-import os
 import sys
 import time
 from datetime import datetime, timezone

@@ -16,7 +16,7 @@ def test_list_templates():
     assert set(list_templates()) >= {
         "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
         "serverless", "torch-digits", "keras-mnist", "gpt-textgen", "moe-textgen",
-        "packed-textgen",
+        "packed-textgen", "bentoml-serving",
     }
 
 
@@ -25,7 +25,7 @@ def test_list_templates():
     [
         "basic", "jax-digits", "mnist-cnn", "bert-finetune", "data-parallel",
         "serverless", "torch-digits", "keras-mnist", "gpt-textgen", "moe-textgen",
-        "packed-textgen",
+        "packed-textgen", "bentoml-serving",
     ],
 )
 def test_render_template_compiles(template, tmp_path):

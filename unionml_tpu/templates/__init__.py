@@ -13,16 +13,18 @@ from typing import List
 TEMPLATES_ROOT = Path(__file__).parent
 
 _DESCRIPTIONS = {
-    "basic": "sklearn digits classifier + HTTP serving (the README quickstart)",
+    "basic": "digits quickstart: from-scratch jax softmax regression + HTTP serving",
     "jax-digits": "jax-native digits MLP with a jit-compiled trainer",
     "mnist-cnn": "CNN image classifier trained with the compiled fit() loop",
     "bert-finetune": "BERT-base text classification fine-tune with checkpointing",
     "data-parallel": "data-parallel training over a TPU mesh (v5e-8 layout)",
     "serverless": "digits classifier behind a FaaS event handler",
+    "bentoml-serving": "digits classifier packaged + served through bentoml build",
     "torch-digits": "pytorch MLP digits classifier (opaque-trainer path)",
     "keras-mnist": "Keras MNIST CNN (the reference tutorial recipe, opaque path)",
     "gpt-textgen": "character-level GPT text generation with KV-cache decoding",
     "moe-textgen": "sparse (mixture-of-experts) GPT text generation with router aux losses",
+    "packed-textgen": "packed-sequence GPT training (fit_lm(pack=True)) + generation",
 }
 
 

@@ -1,0 +1,15 @@
+"""BentoML service definition for {{app_name}} (`bentofile.yaml` points here)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from app import model
+
+from unionml_tpu.services.bentoml_service import BentoMLService
+
+service = BentoMLService(model)
+# bentoml tags must be lowercase; the app name is any valid Python identifier
+BENTO_NAME = "{{app_name}}".lower()
+svc = service.configure(f"{BENTO_NAME}:latest", name=BENTO_NAME)
