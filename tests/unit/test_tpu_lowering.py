@@ -1,0 +1,124 @@
+"""TPU-platform lowering gate for the pallas kernels — runs on CPU.
+
+Round-2 precedent (TPU_PROBES.log 10:25Z): interpret-mode-correct pallas code
+failed MOSAIC LOWERING on first hardware contact (rank-1 SMEM block size 1) —
+a class of bug CPU interpret tests cannot see. ``jax.export`` with
+``platforms=["tpu"]`` runs the real pallas→Mosaic lowering (where that failure
+occurred) without needing a TPU device, so these tests catch lowering
+regressions in every CPU CI run. Every check asserts ``tpu_custom_call`` is in
+the exported module — export SUCCEEDING is not enough, because
+``flash_attention`` silently falls back to the XLA path for unliftable configs
+and that exports fine too. What these tests do NOT prove: Mosaic→machine-code
+compilation and runtime numerics, which remain hardware-gated
+(``bench_kernels.py`` on a live window).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.ops.attention import flash_attention
+
+
+def _assert_mosaic_lowered(fn, *args):
+    exported = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    mlir = exported.mlir_module()
+    # the pallas kernel lowers to a Mosaic tpu_custom_call; its absence means the
+    # call silently routed to the XLA fallback and this test would be vacuous
+    assert "tpu_custom_call" in mlir, "no Mosaic custom call: XLA fallback was exported"
+    return exported
+
+
+def _qkv(batch=2, heads=4, seq=256, dim=64, dtype=jnp.bfloat16, seq_kv=None):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(batch, heads, seq, dim)), dtype)
+    kv_shape = (batch, heads, seq_kv if seq_kv is not None else seq, dim)
+    k = jnp.asarray(rng.normal(size=kv_shape), dtype)
+    v = jnp.asarray(rng.normal(size=kv_shape), dtype)
+    return q, k, v
+
+
+def _segments(batch=2, seq=256):
+    seg = np.zeros((batch, seq), np.int32)
+    seg[:, : seq // 3] = 1
+    seg[:, seq // 3 : (9 * seq) // 10] = 2  # padding tail after segment 2
+    return jnp.asarray(seg)
+
+
+@pytest.mark.parametrize("block_q,block_k", [(128, 128), (256, 128), (256, 256)])
+def test_dense_flash_lowers_for_tpu(block_q, block_k):
+    q, k, v = _qkv()
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=block_q, block_k=block_k)
+
+    _assert_mosaic_lowered(fwd, q, k, v)
+
+    def grads(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(fwd(q, k, v).astype(jnp.float32) ** 2)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    _assert_mosaic_lowered(grads, q, k, v)
+
+
+def test_packed_flash_lowers_for_tpu():
+    """The round-4/5 packed kernel (segment ids, block skipping) has never met
+    hardware; at minimum its Mosaic lowering must hold for fwd AND bwd."""
+    q, k, v = _qkv()
+    seg = _segments()
+
+    def fwd(q, k, v, seg):
+        return flash_attention(q, k, v, segment_ids=seg, causal=True, block_q=128, block_k=128)
+
+    _assert_mosaic_lowered(fwd, q, k, v, seg)
+
+    def grads(q, k, v, seg):
+        def loss(q, k, v):
+            return jnp.sum(fwd(q, k, v, seg).astype(jnp.float32) ** 2)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    _assert_mosaic_lowered(grads, q, k, v, seg)
+
+
+def test_kv_lens_flash_lowers_for_tpu():
+    """The padding-mask (kv_lens SMEM vector) variant — the exact shape family
+    that broke Mosaic lowering in round 2."""
+    q, k, v = _qkv(seq=128)
+    kv_lens = jnp.asarray([100, 128], jnp.int32)
+
+    def fwd(q, k, v, kv_lens):
+        return flash_attention(q, k, v, kv_lens=kv_lens, block_q=128, block_k=128)
+
+    _assert_mosaic_lowered(fwd, q, k, v, kv_lens)
+
+
+def test_tuned_block_tables_lower_for_tpu():
+    """Every committed TUNED_BLOCKS / PACKED_TUNED_BLOCKS entry must stay
+    Mosaic-lowerable: a tuning overlay promoting an unlowering config would
+    break the next hardware run. Shapes honor seq_q != seq_k keys, and the
+    packed table (the kernel that has never met hardware) runs the
+    segment-ids kernel."""
+    from unionml_tpu.ops.tuning import PACKED_TUNED_BLOCKS, TUNED_BLOCKS
+
+    for (seq_q, seq_k, head_dim), (block_q, block_k) in sorted(TUNED_BLOCKS.items()):
+        q, k, v = _qkv(batch=1, heads=2, seq=seq_q, dim=head_dim, seq_kv=seq_k)
+
+        def fwd(q, k, v, bq=block_q, bk=block_k):
+            return flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+
+        _assert_mosaic_lowered(fwd, q, k, v)
+
+    for (seq_q, seq_k, head_dim), (block_q, block_k) in sorted(PACKED_TUNED_BLOCKS.items()):
+        q, k, v = _qkv(batch=1, heads=2, seq=seq_q, dim=head_dim, seq_kv=seq_k)
+        seg = _segments(batch=1, seq=max(seq_q, seq_k))
+
+        def packed_fwd(q, k, v, seg, bq=block_q, bk=block_k):
+            return flash_attention(
+                q, k, v, segment_ids=seg, causal=True, block_q=bq, block_k=bk
+            )
+
+        _assert_mosaic_lowered(packed_fwd, q, k, v, seg)
