@@ -5,12 +5,16 @@ failed MOSAIC LOWERING on first hardware contact (rank-1 SMEM block size 1) —
 a class of bug CPU interpret tests cannot see. ``jax.export`` with
 ``platforms=["tpu"]`` runs the real pallas→Mosaic lowering (where that failure
 occurred) without needing a TPU device, so these tests catch lowering
-regressions in every CPU CI run. Every check asserts ``tpu_custom_call`` is in
-the exported module — export SUCCEEDING is not enough, because
-``flash_attention`` silently falls back to the XLA path for unliftable configs
-and that exports fine too. What these tests do NOT prove: Mosaic→machine-code
-compilation and runtime numerics, which remain hardware-gated
-(``bench_kernels.py`` on a live window).
+regressions in every CPU CI run. Every FLASH-KERNEL check asserts
+``tpu_custom_call`` is in the exported module — export SUCCEEDING is not
+enough, because ``flash_attention`` silently falls back to the XLA path for
+unliftable configs and that exports fine too. The two PROGRAM-level checks
+differ deliberately: the headline train step asserts Mosaic-kernel
+presence/absence CONSISTENT with the measured dispatch verdict, and the
+sharded-parallelism programs (pure XLA collectives, no pallas) assert export
+success only. What none of these prove: Mosaic→machine-code compilation and
+runtime numerics, which remain hardware-gated (``bench_kernels.py`` on a live
+window).
 """
 
 import jax
@@ -148,6 +152,48 @@ def test_headline_bert_train_step_lowers_for_tpu(monkeypatch):
         assert "tpu_custom_call" in mlir, "pallas verdict but no Mosaic kernel exported"
     else:
         assert "tpu_custom_call" not in mlir, "xla verdict but a Mosaic kernel was exported"
+
+
+def test_sharded_parallelism_programs_lower_for_tpu():
+    """The multi-chip shard_map programs (ring SP, pipeline, a2a MoE) must lower
+    for the TPU platform — the CPU dryrun proves numerics, this proves the same
+    collectives (ppermute / all_to_all / psum) lower for the real target."""
+    from unionml_tpu.parallel import make_mesh
+    from unionml_tpu.parallel.ep import moe_apply_a2a
+    from unionml_tpu.parallel.pp import pipeline_apply
+    from unionml_tpu.parallel.ring import ring_attention
+    from unionml_tpu.parallel.ulysses import ulysses_attention
+
+    rng = np.random.default_rng(0)
+
+    ep_mesh = make_mesh({"data": 2, "expert": 4})
+    eW = jnp.asarray(rng.normal(size=(8, 16, 16)) * 0.3, jnp.float32)
+    tokens = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    gates = jax.nn.softmax(jnp.asarray(rng.normal(size=(32, 8)), jnp.float32), axis=-1)
+    a2a = jax.jit(
+        lambda w, t, g: moe_apply_a2a(
+            lambda we, te: te @ we, w, t, g, ep_mesh, k=2, capacity_factor=4.0
+        )
+    )
+    assert jax.export.export(a2a, platforms=["tpu"])(eW, tokens, gates).mlir_module_serialized
+
+    sp_mesh = make_mesh({"data": 2, "sequence": 4})
+    q = jnp.asarray(rng.normal(size=(2, 4, 32, 16)), jnp.float32)  # heads % sequence == 0 (ulysses)
+    for sp_fn in (
+        lambda q, k, v: ring_attention(q, k, v, sp_mesh, causal=True),
+        lambda q, k, v: ulysses_attention(q, k, v, sp_mesh, causal=True),
+    ):
+        assert jax.export.export(jax.jit(sp_fn), platforms=["tpu"])(q, q, q).mlir_module_serialized
+
+    pp_mesh = make_mesh({"data": 2, "stage": 4})
+    stage_w = jnp.asarray(rng.normal(size=(4, 16, 16)) * 0.2, jnp.float32)
+    pp_x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    pp = jax.jit(
+        lambda w, x: pipeline_apply(
+            lambda w, h: jax.nn.relu(h @ w), w, x, pp_mesh, num_microbatches=4
+        )
+    )
+    assert jax.export.export(pp, platforms=["tpu"])(stage_w, pp_x).mlir_module_serialized
 
 
 def test_tuned_block_tables_lower_for_tpu():
