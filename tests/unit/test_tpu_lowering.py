@@ -100,24 +100,39 @@ def test_kv_lens_flash_lowers_for_tpu():
     _assert_mosaic_lowered(fwd, q, k, v, kv_lens)
 
 
+def _abstract_bert_step(config, batch, seq, *, mu_dtype=None, **step_kw):
+    """(train_step, abstract_state, abstract_batch) — eval_shape only, so full
+    BERT-base programs export without materializing gigabytes of params."""
+    from unionml_tpu.models import BertForSequenceClassification, create_train_state
+    from unionml_tpu.models.training import make_classifier_train_step
+
+    model = BertForSequenceClassification(config)
+    abs_state = jax.eval_shape(
+        lambda r: create_train_state(
+            model,
+            model.init({"params": r}, jnp.zeros((1, seq), jnp.int32)),
+            learning_rate=2e-5, warmup_steps=10, total_steps=1000, mu_dtype=mu_dtype,
+        ),
+        jax.random.PRNGKey(0),
+    )
+    abs_batch = {
+        "input_ids": jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+        "attention_mask": jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+        "labels": jax.ShapeDtypeStruct((batch,), jnp.int32),
+    }
+    step = make_classifier_train_step(
+        input_signature=("input_ids", "attention_mask"), **step_kw
+    )
+    return step, abs_state, abs_batch
+
+
 def test_headline_bert_train_step_lowers_for_tpu(monkeypatch):
     """The exact program the driver's bench times (BERT-base bf16, B=64, S=128,
     AdamW step) must lower for the TPU platform — a lowering regression here
-    would turn the once-per-round hardware window into a 0.0 headline.
-
-    Cost note: the only unit test that builds full BERT-base (~30s, ~1.3GB host)
-    — deliberately, because the benched program IS base-sized; everything else
-    in the suite uses tiny configs.
-    """
-    from unionml_tpu.models import (
-        BertConfig,
-        BertForSequenceClassification,
-        create_train_state,
-        init_params,
-    )
+    would turn the once-per-round hardware window into a 0.0 headline."""
     import sys
 
-    from unionml_tpu.models.training import make_classifier_train_step
+    from unionml_tpu.models import BertConfig
     from unionml_tpu.ops.tuning import pick_impl
 
     # the ops package re-exports the attention FUNCTION under the submodule's
@@ -130,19 +145,8 @@ def test_headline_bert_train_step_lowers_for_tpu(monkeypatch):
     monkeypatch.setattr(attention_mod, "on_tpu", lambda: True)
 
     config = BertConfig.base(dtype=jnp.bfloat16)
-    model = BertForSequenceClassification(config)
-    variables = init_params(config, seq_len=128)
-    state = create_train_state(
-        model, variables, learning_rate=2e-5, warmup_steps=10, total_steps=1000
-    )
-    rng = np.random.default_rng(0)
-    batch = {
-        "input_ids": jnp.asarray(rng.integers(0, config.vocab_size, size=(64, 128)), jnp.int32),
-        "attention_mask": jnp.ones((64, 128), jnp.int32),
-        "labels": jnp.asarray(rng.integers(0, config.num_labels, size=(64,)), jnp.int32),
-    }
-    step = make_classifier_train_step(input_signature=("input_ids", "attention_mask"))
-    exported = jax.export.export(step, platforms=["tpu"])(state, batch)
+    step, abs_state, abs_batch = _abstract_bert_step(config, batch=64, seq=128)
+    exported = jax.export.export(step, platforms=["tpu"])(abs_state, abs_batch)
     mlir = exported.mlir_module()
     # the assertion tracks the measured dispatch verdict: with 'pallas' promoted
     # for the headline shape the export must carry the Mosaic kernel; with 'xla'
@@ -152,6 +156,78 @@ def test_headline_bert_train_step_lowers_for_tpu(monkeypatch):
         assert "tpu_custom_call" in mlir, "pallas verdict but no Mosaic kernel exported"
     else:
         assert "tpu_custom_call" not in mlir, "xla verdict but a Mosaic kernel was exported"
+
+
+def test_mfu_ladder_variants_lower_for_tpu(monkeypatch):
+    """Every bench_mfu.py hardware variant (remat, grad accumulation, bf16 adam
+    moments, long-seq) must lower for the TPU platform — each is one battery
+    slot during a rare window, and a lowering failure there would waste it."""
+    import sys
+
+    from unionml_tpu.models import BertConfig
+
+    # same hardware-dispatch patch as the headline test: without it the export
+    # would trace the CPU attention branch, not the program the battery runs
+    monkeypatch.setattr(sys.modules["unionml_tpu.ops.attention"], "on_tpu", lambda: True)
+
+    variants = [
+        dict(batch=256, seq=128, cfg=dict(remat=True)),
+        dict(batch=512, seq=128, cfg=dict(remat=True), step=dict(grad_accum=4)),
+        dict(batch=256, seq=128, cfg=dict(remat=True), mu=jnp.bfloat16),
+        dict(batch=64, seq=512, cfg=dict(remat=True)),
+    ]
+    for spec in variants:
+        config = BertConfig.base(dtype=jnp.bfloat16, **spec.get("cfg", {}))
+        step, abs_state, abs_batch = _abstract_bert_step(
+            config, batch=spec["batch"], seq=spec["seq"],
+            mu_dtype=spec.get("mu"), **spec.get("step", {}),
+        )
+        exported = jax.export.export(step, platforms=["tpu"])(abs_state, abs_batch)
+        assert exported.mlir_module_serialized, spec
+
+
+def test_int8_decode_at_scale_lowers_for_tpu():
+    """bench_int8.py's ~1.3B-param quantized decode programs lower for TPU —
+    exported from abstract (eval_shape) params/cache, so no memory is
+    materialized. Covers BOTH phases the engine compiles: chunked prefill
+    (cache write at position 0) and the cached single-token decode step
+    (cache scatter/gather + per-token attention + dequant-fused matmuls)."""
+    from unionml_tpu.models.gpt import GPTConfig, GPTLMHeadModel, init_cache
+    from unionml_tpu.ops.quant import dequantize_tree, quantize_tree
+
+    config = GPTConfig(
+        vocab_size=50257, hidden_size=2048, num_layers=24, num_heads=16,
+        max_position_embeddings=256, dropout=0.0, dtype=jnp.bfloat16,
+    )
+    model = GPTLMHeadModel(config)
+    abs_vars = jax.eval_shape(
+        lambda r: model.init({"params": r}, jnp.zeros((1, 8), jnp.int32), deterministic=True),
+        jax.random.PRNGKey(0),
+    )
+    abs_qvars = jax.eval_shape(quantize_tree, abs_vars)
+    abs_cache = jax.eval_shape(lambda: init_cache(config, 1, 128))
+    abs_position = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def prefill(qvars, ids, cache):
+        return model.apply(
+            dequantize_tree(qvars), ids, cache=cache, position=0, deterministic=True
+        )
+
+    exported = jax.export.export(jax.jit(prefill), platforms=["tpu"])(
+        abs_qvars, jax.ShapeDtypeStruct((1, 8), jnp.int32), abs_cache
+    )
+    assert exported.mlir_module_serialized
+
+    def decode_step(qvars, token, cache, position):
+        return model.apply(
+            dequantize_tree(qvars), token, cache=cache, position=position,
+            deterministic=True,
+        )
+
+    exported = jax.export.export(jax.jit(decode_step), platforms=["tpu"])(
+        abs_qvars, jax.ShapeDtypeStruct((1, 1), jnp.int32), abs_cache, abs_position
+    )
+    assert exported.mlir_module_serialized
 
 
 def test_sharded_parallelism_programs_lower_for_tpu():
