@@ -202,18 +202,7 @@ def _chip_peak_flops():
     return None
 
 
-def resolve_artifact_path(out_path: str, backend: str) -> str:
-    """Where a bench run may write its committed artifact.
-
-    One policy for every bench script: accelerator runs own the canonical
-    artifact name; CPU smoke runs divert to a ``_cpu``-suffixed sibling
-    (gitignored) so host timings can never overwrite the TPU measurements
-    BASELINE.md quotes as the one source of truth.
-    """
-    if backend != "cpu":
-        return out_path
-    base, ext = os.path.splitext(out_path)
-    return f"{base}_cpu{ext}"
+from bench_util import resolve_artifact_path  # noqa: E402,F401 - shared bench policy
 
 
 def _emit_zero_and_exit(reason: str):

@@ -106,7 +106,7 @@ def main():
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         **results,
     }
-    from bench import resolve_artifact_path
+    from bench_util import resolve_artifact_path
 
     out_path = resolve_artifact_path(
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "INT8_BENCH.json"),

@@ -419,7 +419,7 @@ def main():
         out_path, metric = "KERNEL_BENCH.json", "kernel_sweep"
 
     payload["recorded_at"] = datetime.now(timezone.utc).isoformat(timespec="seconds")
-    from bench import resolve_artifact_path
+    from bench_util import resolve_artifact_path
 
     out_path = resolve_artifact_path(out_path, backend)
     with open(out_path, "w") as fh:

@@ -173,7 +173,7 @@ def main():
     # accelerator runs own MFU_SWEEP.json — including all-errors sweeps, whose
     # error entries + stamp must replace stale numbers rather than impersonate
     # them; CPU smoke runs divert to the _cpu sibling (shared bench policy)
-    from bench import resolve_artifact_path
+    from bench_util import resolve_artifact_path
 
     out_path = resolve_artifact_path(
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "MFU_SWEEP.json"),

@@ -425,14 +425,14 @@ def main():
         "--out",
         default="SERVING_BENCH.json",
         help="artifact path; CPU runs divert to a _cpu-suffixed sibling "
-        "(bench.resolve_artifact_path) so a local smoke run cannot overwrite "
+        "(bench_util.resolve_artifact_path) so a local smoke run cannot overwrite "
         "the committed TPU measurements BASELINE.md quotes",
     )
     args = parser.parse_args()
 
     import jax
 
-    from bench import resolve_artifact_path
+    from bench_util import resolve_artifact_path
 
     backend = jax.default_backend()
     args.out = resolve_artifact_path(args.out, backend)

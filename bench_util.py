@@ -1,0 +1,23 @@
+"""Side-effect-free helpers shared by the bench scripts.
+
+Deliberately free of module-level configuration: ``bench.py`` sets process-wide
+logging levels and a persistent XLA compile-cache env var at import, which the
+other bench scripts must NOT inherit just to reuse a path-policy function
+(a warm compile cache silently flatters first-request/warmup timings).
+"""
+
+import os
+
+
+def resolve_artifact_path(out_path: str, backend: str) -> str:
+    """Where a bench run may write its committed artifact.
+
+    One policy for every bench script: accelerator runs own the canonical
+    artifact name; CPU smoke runs divert to a ``_cpu``-suffixed sibling
+    (gitignored) so host timings can never overwrite the TPU measurements
+    BASELINE.md quotes as the one source of truth.
+    """
+    if backend != "cpu":
+        return out_path
+    base, ext = os.path.splitext(out_path)
+    return f"{base}_cpu{ext}"

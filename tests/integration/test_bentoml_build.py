@@ -155,7 +155,11 @@ def test_bentoml_build_and_serve(tmp_path):
     )
 
     # 3) serve the BUILT bento as a subprocess and predict over HTTP
-    port = 3059
+    import socket
+
+    with socket.socket() as probe:  # ephemeral port: parallel CI runs must not collide
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
     server = subprocess.Popen(
         [cli, "serve", "digits_clf_bento:latest", "--port", str(port)],
         env=env,

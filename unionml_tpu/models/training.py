@@ -188,11 +188,30 @@ def _wrap_step(train_step: Callable, mesh: Optional[Mesh], param_spec: Any) -> C
     # whose layout also evolves onto the step's OUTPUT sharding after the first
     # donated call), uncommitted leaves replicate onto the mesh — the plain-DP
     # default an explicit replicated() used to force.
-    return jax.jit(
+    jitted = jax.jit(
         train_step,
         in_shardings=(None, batch_sharding(mesh)),
         donate_argnums=(0,),
     )
+    mesh_devices = set(mesh.devices.flat)
+
+    def call(state, batch):
+        # leaves committed to some OTHER device set (a single-device checkpoint
+        # restore, an explicit device_put) would make jit raise an
+        # incompatible-devices error against the mesh-sharded batch; reshard
+        # them onto the mesh up front — the acceptance replicated() used to
+        # provide. Leaves already on this mesh (or uncommitted) pass through.
+        def place(leaf):
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None:  # numpy / scalars: jit replicates them itself
+                return leaf
+            if set(getattr(sharding, "device_set", mesh_devices)) == mesh_devices:
+                return leaf
+            return jax.device_put(leaf, NamedSharding(mesh, PartitionSpec()))
+
+        return jitted(jax.tree_util.tree_map(place, state), batch)
+
+    return call
 
 
 def make_lm_train_step(

@@ -111,6 +111,13 @@ def load_native_library() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32),
             ]
+            lib.upk_count_rows.restype = ctypes.c_longlong
+            lib.upk_count_rows.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_longlong,
+                ctypes.c_longlong,
+                ctypes.c_longlong,
+            ]
         except AttributeError as exc:
             # a stale cached library from an older package version can lack newer
             # symbols while carrying a fresher mtime than the sources; missing
@@ -147,14 +154,22 @@ def pack_sequences_native(
     flat_tokens = np.ascontiguousarray(flat_tokens, dtype=np.int32)
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
     n_seqs = int(lengths.size)
-    max_rows = max(n_seqs, 1)
-    input_ids = np.empty((max_rows, seq_len), dtype=np.int32)
-    segment_ids = np.empty((max_rows, seq_len), dtype=np.int32)
-    positions = np.empty((max_rows, seq_len), dtype=np.int32)
+    lengths_ptr = lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    # two-pass protocol: count rows first, allocate EXACT outputs — a
+    # worst-case (n_seqs, seq_len) x3 allocation is multi-GB at the corpus
+    # scales this packer exists for. The count runs the identical first-fit
+    # loop, so upk_pack fills exactly n_rows rows.
+    n_rows = lib.upk_count_rows(lengths_ptr, n_seqs, seq_len, max_segments_per_row)
+    if n_rows < 0:
+        logger.warning("Native packer rejected inputs (rc=%d); using the Python path.", n_rows)
+        return None
+    input_ids = np.empty((n_rows, seq_len), dtype=np.int32)
+    segment_ids = np.empty((n_rows, seq_len), dtype=np.int32)
+    positions = np.empty((n_rows, seq_len), dtype=np.int32)
     as_i32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-    n_rows = lib.upk_pack(
+    packed_rows = lib.upk_pack(
         as_i32(flat_tokens),
-        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lengths_ptr,
         n_seqs,
         seq_len,
         pad_id,
@@ -163,17 +178,16 @@ def pack_sequences_native(
         as_i32(segment_ids),
         as_i32(positions),
     )
-    if n_rows < 0:
-        logger.warning("Native packer rejected inputs (rc=%d); using the Python path.", n_rows)
+    if packed_rows != n_rows:  # defensive: the two passes must agree exactly
+        logger.warning(
+            "Native packer row-count mismatch (%d vs %d); using the Python path.",
+            packed_rows, n_rows,
+        )
         return None
-    # copy out of the worst-case buffers: a slice view (ascontiguousarray
-    # included — a contiguous leading slice IS contiguous) would keep all
-    # max_rows x seq_len x 3 arrays alive behind the (much smaller) result
-    shrink = (lambda a: a[:n_rows].copy()) if n_rows < max_rows else (lambda a: a)
     return {
-        "input_ids": shrink(input_ids),
-        "segment_ids": shrink(segment_ids),
-        "positions": shrink(positions),
+        "input_ids": input_ids,
+        "segment_ids": segment_ids,
+        "positions": positions,
     }
 
 

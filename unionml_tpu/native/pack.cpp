@@ -21,6 +21,45 @@
 
 extern "C" {
 
+// First-fit row count only (no writes): the Python wrapper calls this first
+// and allocates EXACT outputs — a worst-case (n_seqs, seq_len) allocation
+// would be multi-GB at the corpus scales this packer exists for. Runs the
+// identical placement loop, so the subsequent upk_pack call fills exactly
+// this many rows. Returns -1 on invalid arguments.
+int64_t upk_count_rows(const int64_t* lengths,
+                       int64_t n_seqs,
+                       int64_t seq_len,
+                       int64_t max_segments) {
+  if (seq_len <= 0 || n_seqs < 0) return -1;
+  struct Row {
+    int64_t space;
+    int64_t segments;
+  };
+  std::vector<Row> rows;
+  std::vector<int64_t> scan_from(static_cast<size_t>(seq_len) + 1, 0);
+  for (int64_t i = 0; i < n_seqs; ++i) {
+    const int64_t len = lengths[i];
+    if (len <= 0 || len > seq_len) return -1;
+    int64_t placed = -1;
+    int64_t r = scan_from[static_cast<size_t>(len)];
+    for (; r < static_cast<int64_t>(rows.size()); ++r) {
+      const Row& row = rows[static_cast<size_t>(r)];
+      if (row.space >= len && (max_segments <= 0 || row.segments < max_segments)) {
+        placed = r;
+        break;
+      }
+    }
+    scan_from[static_cast<size_t>(len)] = r;
+    if (placed < 0) {
+      rows.push_back(Row{seq_len, 0});
+      placed = static_cast<int64_t>(rows.size()) - 1;
+    }
+    rows[static_cast<size_t>(placed)].space -= len;
+    rows[static_cast<size_t>(placed)].segments += 1;
+  }
+  return rows.empty() ? 1 : static_cast<int64_t>(rows.size());
+}
+
 // Returns the number of rows written, or -1 on invalid arguments.
 int64_t upk_pack(const int32_t* tokens,   // concatenated sequence tokens
                  const int64_t* lengths,  // per-sequence lengths, each in [1, seq_len]
