@@ -376,6 +376,58 @@ def test_native_packer_matches_python():
         )
 
 
+def _native_pack_loadbearing(seqs, seq_len, cap):
+    """Run the NATIVE packer directly (no silent Python fallback): normalize the
+    corpus exactly as pack_sequences does, call pack_sequences_native, and fail
+    the test if the native path declined — a fallback would make any parity
+    comparison Python-vs-Python, vacuously green on the exact bug class these
+    tests guard."""
+    from unionml_tpu.native import pack_sequences_native
+
+    arrays = []
+    for seq in seqs:
+        arr = np.asarray(seq).reshape(-1)
+        if arr.size == 0:
+            continue
+        arrays.append(arr[:seq_len])
+    lengths = np.asarray([a.size for a in arrays], dtype=np.int64)
+    flat = (
+        np.concatenate([a.astype(np.int32, copy=False) for a in arrays])
+        if arrays
+        else np.empty((0,), dtype=np.int32)
+    )
+    out = pack_sequences_native(flat, lengths, seq_len, 0, cap)
+    assert out is not None, "native packer fell back; parity check would be vacuous"
+    return out
+
+
+def test_native_packer_fuzz_parity():
+    """Seeded fuzz: 20 random (corpus, seq_len, cap) cases must stay
+    byte-identical between the C++ and Python packers — the durable guard for
+    the native code's scan-cursor and two-pass-allocation logic."""
+    from unionml_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+
+    rng = np.random.default_rng(1234)
+    for case in range(20):
+        seq_len = int(rng.integers(8, 192))
+        n = int(rng.integers(0, 600))
+        cap = int(rng.integers(0, 5))
+        max_len = int(rng.integers(1, 2 * seq_len + 1))
+        seqs = [
+            rng.integers(1, 30000, size=int(k))
+            for k in rng.integers(0, max_len + 1, size=n)  # includes empties
+        ]
+        py = pack_sequences(seqs, seq_len, impl="python", max_segments_per_row=cap)
+        nat = _native_pack_loadbearing(seqs, seq_len, cap)
+        for key in ("input_ids", "segment_ids", "positions"):
+            np.testing.assert_array_equal(
+                py[key], nat[key], err_msg=f"case {case}: {key} (n={n}, L={seq_len}, cap={cap})"
+            )
+
+
 def test_pack_sequences_rejects_unknown_impl():
     with pytest.raises(ValueError, match="impl must be"):
         pack_sequences([np.arange(4)], 8, impl="cuda")
