@@ -18,7 +18,7 @@ from datetime import datetime, timezone
 
 import numpy as np
 
-from unionml_tpu.native import native_available
+from unionml_tpu.native import native_available, pack_sequences_native
 from unionml_tpu.ops.packing import pack_sequences, packing_efficiency
 
 
@@ -50,7 +50,22 @@ def main():
     check = corpus[:5000]
     py_small = pack_sequences(check, seq_len, impl="python")
     if native_available():
-        nat_small = pack_sequences(check, seq_len, impl="native")
+        # call the native wrapper DIRECTLY: pack_sequences(impl="native") falls
+        # back to Python when the wrapper returns None, which would silently
+        # degrade this gate to Python-vs-Python and certify nothing
+        arrays = [np.asarray(s).reshape(-1)[:seq_len] for s in check]
+        arrays = [a for a in arrays if a.size]
+        nat_small = pack_sequences_native(
+            np.concatenate(arrays).astype(np.int32),
+            np.array([a.size for a in arrays], dtype=np.int64),
+            seq_len,
+            pad_id=0,
+            max_segments_per_row=0,
+        )
+        if nat_small is None:
+            print(json.dumps({"metric": "packing_throughput",
+                              "error": "native packer unavailable mid-bench (returned None)"}))
+            return 1
         for key in ("input_ids", "segment_ids", "positions"):
             if not np.array_equal(py_small[key], nat_small[key]):
                 print(json.dumps({"metric": "packing_throughput", "error": f"parity {key}"}))

@@ -292,21 +292,30 @@ def bench_http(iters: int = 200):
         stop()
 
 
-def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int = 8, lookahead: int = 8):
-    """Continuous-batching /generate over real HTTP: per-completion latency plus
-    aggregate decode throughput under concurrent load (the continuous-batching
-    payoff: N concurrent requests share every decode step)."""
-    import json as _json
-    import threading
-    import types
+def _serving_mesh(n_devices: int, num_heads: int):
+    """A {data, tensor} serving mesh over the first ``n_devices`` devices, the
+    tensor axis as wide as the head count divides (KV shards over heads)."""
+    import jax
 
+    from unionml_tpu.parallel import make_mesh
+
+    tensor = 1
+    for cand in (8, 4, 2):
+        if cand <= n_devices and num_heads % cand == 0 and n_devices % cand == 0:
+            tensor = cand
+            break
+    return make_mesh(
+        {"data": n_devices // tensor, "tensor": tensor}, devices=jax.devices()[:n_devices]
+    )
+
+
+def _bench_gpt():
+    """The decoder every generation bench serves (tiny on CPU, GPT-2 small on TPU)."""
     import jax
     import jax.numpy as jnp
 
     from unionml_tpu.models import GPTConfig, GPTLMHeadModel
     from unionml_tpu.models.gpt import init_params
-    from unionml_tpu.serving import build_aiohttp_app
-    from unionml_tpu.serving.continuous import DecodeEngine
 
     if jax.default_backend() == "cpu":
         config = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
@@ -314,13 +323,36 @@ def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int =
         config = GPTConfig(dropout=0.0, dtype=jnp.bfloat16)
     model = GPTLMHeadModel(config)
     variables = init_params(config, seq_len=16)
+    return config, model, variables
+
+
+def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int = 8,
+                   lookahead: int = 8, mesh_devices: int = 0):
+    """Continuous-batching /generate over real HTTP: per-completion latency plus
+    aggregate decode throughput under concurrent load (the continuous-batching
+    payoff: N concurrent requests share every decode step).
+
+    ``mesh_devices=N`` serves the SHARDED engine (params Megatron-split, KV cache
+    sharded over heads) across an N-device {data, tensor} mesh — the multi-chip
+    serving path, same HTTP surface."""
+    import json as _json
+    import threading
+    import types
+
+    config, model, variables = _bench_gpt()
+    mesh = _serving_mesh(mesh_devices, config.num_heads) if mesh_devices else None
+
+    from unionml_tpu.serving import build_aiohttp_app
+    from unionml_tpu.serving.continuous import DecodeEngine
+
     stub = types.SimpleNamespace(name="generate_bench_model", artifact=object())
 
     port, stop = _serve_app(
         build_aiohttp_app(
             stub, resident=False, coalesce=False,
             generator=lambda: DecodeEngine(
-                model, variables, num_slots=concurrency, max_len=128, prefill_buckets=(8, 16)
+                model, variables, num_slots=concurrency, max_len=128,
+                prefill_buckets=(8, 16), mesh=mesh,
             ),
             # fuse decode steps per device dispatch: cuts per-token host syncs
             # (the dominant cost on remote devices; measurable device-local too)
@@ -353,10 +385,70 @@ def bench_generate(iters: int = 30, max_new_tokens: int = 16, concurrency: int =
         total_tokens = concurrency * n_each * max_new_tokens
         stats["concurrency"] = concurrency
         stats["lookahead"] = lookahead
+        stats["mesh_devices"] = mesh_devices or 1
         stats["tokens_per_s_concurrent"] = round(total_tokens / elapsed, 1)
         return stats
     finally:
         stop()
+
+
+def bench_prefill_mix(n_prompts: int = 16, prompt_len: int = 48, max_new_tokens: int = 4,
+                      prefill_batch: int = 4, mesh_devices: int = 0):
+    """Prefill-heavy mix: N long-prompt/short-completion requests queued at once.
+
+    The admission-bottleneck scenario from serving/continuous.py — prompt-heavy
+    load used to serialize one prefill dispatch per prompt. Measures the batched
+    path (⌈N/prefill_batch⌉ dispatches) against the serial one (prefill_batch=1)
+    on the SAME engine config, engine-level for a clean device-dispatch count
+    (no HTTP jitter in a number meant for hardware-window comparison).
+    """
+    config, model, variables = _bench_gpt()
+    mesh = _serving_mesh(mesh_devices, config.num_heads) if mesh_devices else None
+
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config.vocab_size, size=prompt_len).tolist() for _ in range(n_prompts)]
+    requests = [(p, max_new_tokens) for p in prompts]
+    bucket = 1 << (prompt_len - 1).bit_length()
+
+    def run(batch_size):
+        engine = DecodeEngine(
+            model, variables, num_slots=n_prompts, max_len=2 * bucket,
+            prefill_buckets=(bucket,), prefill_batch=batch_size, mesh=mesh,
+        )
+        # warm the (batch_size, bucket) prefill/insert/decode programs so the
+        # timed admission measures dispatches, not XLA compiles
+        engine.admit_many(requests[:batch_size])
+        while engine.num_active:
+            engine.step()
+        warm_dispatches = engine.prefill_dispatches
+        t0 = time.perf_counter()
+        slots = engine.admit_many(requests)
+        admit_s = time.perf_counter() - t0
+        while engine.num_active:
+            engine.step()
+        total_s = time.perf_counter() - t0
+        return {
+            "admit_s": round(admit_s, 4),
+            "total_s": round(total_s, 4),
+            "prefill_dispatches": engine.prefill_dispatches - warm_dispatches,
+            "prompts_per_s_admission": round(len(slots) / admit_s, 1),
+        }
+
+    batched = run(prefill_batch)
+    serial = run(1)
+    return {
+        "n_prompts": n_prompts,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "prefill_batch": prefill_batch,
+        "mesh_devices": mesh_devices or 1,
+        "batched": batched,
+        "serial": serial,
+        "admission_speedup": round(serial["admit_s"] / batched["admit_s"], 2)
+        if batched["admit_s"] else None,
+    }
 
 
 def bench_speculative(iters: int = 20, max_new_tokens: int = 32, gamma: int = 4):
@@ -421,6 +513,14 @@ def main():
     parser.add_argument("--bert-base", action="store_true", help="bench full BERT-base (TPU)")
     parser.add_argument("--speculative", action="store_true",
                         help="also bench speculative vs plain single-stream generation")
+    parser.add_argument("--mesh", type=int, default=0, metavar="N",
+                        help="serve the generation benches tensor-parallel over an N-device "
+                        "{data, tensor} mesh (params Megatron-split, KV cache head-sharded). "
+                        "Runs ONLY the generate + prefill-mix phases, so the hardware-window "
+                        "battery can time the sharded path without re-paying the MLP/BERT benches")
+    parser.add_argument("--prefill-heavy", action="store_true",
+                        help="also bench the prefill-heavy admission mix (batched vs serial "
+                        "prefill dispatches)")
     parser.add_argument(
         "--out",
         default="SERVING_BENCH.json",
@@ -435,6 +535,11 @@ def main():
     from bench_util import resolve_artifact_path
 
     backend = jax.default_backend()
+    if args.mesh:
+        import os
+
+        base, ext = os.path.splitext(args.out)
+        args.out = f"{base}_mesh{args.mesh}{ext}"
     args.out = resolve_artifact_path(args.out, backend)
     results = {
         "backend": backend,
@@ -442,6 +547,28 @@ def main():
         "cold_start_excluded": True,
         "models": {},
     }
+
+    if args.mesh:
+        if len(jax.devices()) < args.mesh:
+            print(json.dumps({"metric": "http_generate_p50_ms",
+                              "error": f"--mesh {args.mesh} needs {args.mesh} devices, "
+                              f"found {len(jax.devices())}", "backend": backend}))
+            return 1
+        gen = bench_generate(mesh_devices=args.mesh)
+        gen_name = ("gpt_tiny" if backend == "cpu" else "gpt2_small") + f"_generate_http_mesh{args.mesh}"
+        results["models"][gen_name] = gen
+        print(json.dumps({"metric": "http_generate_p50_ms", "value": gen["p50_ms"], "unit": "ms",
+                          "model": gen_name, "tokens_per_s_concurrent": gen["tokens_per_s_concurrent"],
+                          "mesh_devices": args.mesh, "backend": backend}))
+        mix = bench_prefill_mix(mesh_devices=args.mesh)
+        results["models"][f"prefill_mix_mesh{args.mesh}"] = mix
+        print(json.dumps({"metric": "prefill_admission_speedup", "value": mix["admission_speedup"],
+                          "unit": "x", "dispatches": mix["batched"]["prefill_dispatches"],
+                          "mesh_devices": args.mesh, "backend": backend}))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        return 0
 
     mlp = bench_mlp()
     results["models"]["digits_mlp_64f"] = mlp
@@ -466,6 +593,13 @@ def main():
                       "model": gen_name, "tokens_per_s_concurrent": gen["tokens_per_s_concurrent"],
                       "backend": backend}))
 
+    if args.prefill_heavy:
+        mix = bench_prefill_mix()
+        results["models"]["prefill_mix"] = mix
+        print(json.dumps({"metric": "prefill_admission_speedup", "value": mix["admission_speedup"],
+                          "unit": "x", "dispatches": mix["batched"]["prefill_dispatches"],
+                          "backend": backend}))
+
     if args.speculative:
         spec = bench_speculative()
         results["models"]["speculative_vs_plain_http"] = spec
@@ -480,4 +614,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

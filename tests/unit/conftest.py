@@ -1,1 +1,27 @@
+"""Unit-suite fixtures: the shared app models plus session-scoped heavy models.
+
+``gpt_tiny_session`` is the ONE tiny f32 GPT shared by the serving/engine suites
+(test_gpt, test_continuous, test_continuous_sharded): init_params alone costs a
+jitted init per module, and every module re-deriving the same reference
+completions re-pays the generate compile — session scope pays both once for the
+whole run. The fixture value is treated as immutable by every consumer (engines
+never mutate ``variables``; they donate only their own cache/logits buffers).
+"""
+
+import pytest
+
 from tests.unit.model_fixtures import *  # noqa: F401,F403
+
+
+@pytest.fixture(scope="session")
+def gpt_tiny_session():
+    """(config, model, variables) for the tiny f32 GPT every engine suite shares."""
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GPTConfig, GPTLMHeadModel
+    from unionml_tpu.models.gpt import init_params
+
+    config = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
+    model = GPTLMHeadModel(config)
+    variables = init_params(config, seq_len=16)
+    return config, model, variables

@@ -210,3 +210,31 @@ def test_idle_gap_is_clamped_in_ema():
 
     ema = asyncio.run(scenario())
     assert ema <= 10 * 0.002 + 1e-9  # clamped to 10x the wait window, not 60s
+
+
+def test_preferred_multiple_tops_up_once_then_flushes():
+    """A shard-uneven drain under preferred_multiple waits ONE extra window for
+    stragglers (reaching a shard-even batch when they arrive), and flushes
+    regardless when they don't — bounded latency either way."""
+    calls = []
+
+    def predict(rows):
+        calls.append(len(rows))
+        return list(rows)
+
+    async def main():
+        batcher = RequestBatcher(
+            predict, max_batch=8, max_wait_ms=40.0, adaptive=False, preferred_multiple=2
+        )
+        first = asyncio.ensure_future(batcher.submit(["a"]))  # 1 row: shard-uneven
+        await asyncio.sleep(0.05)  # inside the top-up window
+        second = asyncio.ensure_future(batcher.submit(["b", "c"]))
+        results = await asyncio.gather(first, second)
+        # lone-row flush still happens if nothing ever arrives
+        third = await batcher.submit(["d"])
+        batcher.close()
+        return results, third
+
+    (first, second), third = asyncio.new_event_loop().run_until_complete(main())
+    assert first == ["a"] and second == ["b", "c"] and third == ["d"]
+    assert calls[-1] == 1  # the lone trailing row flushed despite being uneven

@@ -21,9 +21,10 @@ CONFIG = GPTConfig.tiny(dropout=0.0, dtype=jnp.float32, attention_impl="xla")
 
 
 @pytest.fixture(scope="module")
-def gpt():
-    model = GPTLMHeadModel(CONFIG)
-    variables = init_params(CONFIG, seq_len=16)
+def gpt(gpt_tiny_session):
+    # session-scoped model/params (shared with test_gpt and the sharded-engine
+    # suite): one init + one set of reference-generate compiles for the whole run
+    _, model, variables = gpt_tiny_session
     return model, variables
 
 

@@ -16,11 +16,9 @@ from unionml_tpu.models.gpt import (
 
 
 @pytest.fixture(scope="module")
-def tiny():
-    cfg = GPTConfig.tiny(dtype=jnp.float32, dropout=0.0, attention_impl="xla")
-    model = GPTLMHeadModel(cfg)
-    variables = init_params(cfg, seq_len=16)
-    return cfg, model, variables
+def tiny(gpt_tiny_session):
+    # session-scoped (shared with the serving/engine suites): one init for the run
+    return gpt_tiny_session
 
 
 def test_forward_shapes(tiny):

@@ -217,16 +217,15 @@ def test_prefetch_deferred_release_python_fallback():
     loader.close()
 
 
-def test_stale_library_missing_symbols_degrades_to_python(tmp_path, monkeypatch):
-    """A cached .so from an older package version (no upk_pack) with a fresh
-    mtime must degrade to the Python paths, not raise AttributeError."""
+def _build_stale_lib(tmp_path):
+    """A cached .so from an 'older package version': prefetch.cpp only (no upk_*
+    symbols), mtime pushed past every source so the staleness check passes it."""
+    import os
     import subprocess
-    import sys
     import time
 
     import unionml_tpu.native as native_mod
 
-    # build a lib WITHOUT pack.cpp into an isolated UNIONML_TPU_HOME
     home = tmp_path / "home"
     lib_dir = home / "native"
     lib_dir.mkdir(parents=True)
@@ -236,15 +235,58 @@ def test_stale_library_missing_symbols_degrades_to_python(tmp_path, monkeypatch)
          str(native_mod._SOURCES[0]), "-o", str(lib_path)],
         check=True, capture_output=True,
     )
-    future = time.time() + 3600  # newer than every source: the rebuild check passes it
-    import os
+    future = time.time() + 3600
     os.utime(lib_path, (future, future))
+    return home, lib_path
 
+
+def test_stale_library_missing_symbols_self_heals(tmp_path, monkeypatch):
+    """A cached .so from an older package version (no upk_pack) with a fresh
+    mtime is deleted and rebuilt ONCE from the current sources — the native
+    path comes back without anyone hand-deleting the cache."""
+    import unionml_tpu.native as native_mod
+
+    home, lib_path = _build_stale_lib(tmp_path)
     monkeypatch.setenv("UNIONML_TPU_HOME", str(home))
     monkeypatch.setattr(native_mod, "_lib", None)
     monkeypatch.setattr(native_mod, "_build_failed", False)
     try:
+        lib = native_mod.load_native_library()
+        assert lib is not None and hasattr(lib, "upk_pack")  # healed, full symbol set
+        assert native_mod.native_available()
+        out = native_mod.pack_sequences_native(
+            np.arange(1, 5, dtype=np.int32), np.array([4], dtype=np.int64), 8, 0, 0
+        )
+        assert out is not None and out["input_ids"].shape == (1, 8)
+    finally:
+        monkeypatch.setattr(native_mod, "_lib", None)
+        monkeypatch.setattr(native_mod, "_build_failed", False)
+
+
+def test_stale_library_degrades_when_rebuild_stays_stale(tmp_path, monkeypatch):
+    """If the rebuild ALSO lacks the symbols (wedged toolchain/cache), one retry
+    then degrade to the Python paths — never an AttributeError, never a loop."""
+    import ctypes
+
+    import unionml_tpu.native as native_mod
+
+    home, lib_path = _build_stale_lib(tmp_path)
+    calls = {"n": 0}
+
+    def rebuild_stale(path):
+        # stands in for a wedged rebuild that keeps producing the old library
+        calls["n"] += 1
+        if not path.exists():
+            _build_stale_lib(tmp_path)
+        return ctypes.CDLL(str(path))
+
+    monkeypatch.setenv("UNIONML_TPU_HOME", str(home))
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_build_failed", False)
+    monkeypatch.setattr(native_mod, "_rebuild_and_load_fresh", rebuild_stale)
+    try:
         assert native_mod.load_native_library() is None  # degraded, no AttributeError
+        assert calls["n"] == 1  # exactly one rebuild attempt, then give up
         assert not native_mod.native_available()
         # the public packing entrypoint still works via the Python path
         from unionml_tpu.ops.packing import pack_sequences
@@ -254,3 +296,18 @@ def test_stale_library_missing_symbols_degrades_to_python(tmp_path, monkeypatch)
     finally:
         monkeypatch.setattr(native_mod, "_lib", None)
         monkeypatch.setattr(native_mod, "_build_failed", False)
+
+
+def test_pack_rejects_short_token_buffer():
+    """lengths summing past flat_tokens.size is the C++ OOB-read shape: the
+    wrapper must reject it (None -> Python path), never call into upk_pack."""
+    from unionml_tpu.native import pack_sequences_native
+
+    flat = np.arange(5, dtype=np.int32)  # 5 tokens on the buffer...
+    lengths = np.array([4, 6], dtype=np.int64)  # ...but lengths claim 10
+    assert pack_sequences_native(flat, lengths, 8, 0, 0) is None
+    # the aligned call still packs natively (the guard is precise, not a blanket)
+    ok = pack_sequences_native(
+        np.arange(10, dtype=np.int32), np.array([4, 6], dtype=np.int64), 8, 0, 0
+    )
+    assert ok is not None and ok["input_ids"].shape[0] >= 1

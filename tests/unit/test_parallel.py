@@ -93,7 +93,9 @@ def test_ring_attention_matches_full(causal):
     )
     ref = xla_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
-    assert out.sharding.spec == shd.spec
+    # layout equivalence, not spec string equality: jax versions differ on
+    # whether shard_map outputs carry trailing-None spec entries
+    assert out.sharding.is_equivalent_to(shd, out.ndim)
 
 
 def test_ring_attention_grad_flows():
@@ -133,7 +135,9 @@ def test_ulysses_attention_matches_full(causal):
     )
     ref = xla_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
-    assert out.sharding.spec == shd.spec
+    # layout equivalence, not spec string equality: jax versions differ on
+    # whether shard_map outputs carry trailing-None spec entries
+    assert out.sharding.is_equivalent_to(shd, out.ndim)
 
 
 def test_ulysses_rejects_indivisible_heads():

@@ -237,3 +237,32 @@ def test_resident_device_stats_record_per_request_latency():
     # recording it would plant a bogus compile-time outlier in device_p99_ms
     assert stats["count"] == 4
     assert 0 < stats["device_p50_ms"] <= stats["device_p99_ms"]
+
+
+def test_resident_mesh_sharded_predictions_identical():
+    """A mesh-resident predictor (replicated params, data-sharded batches) must
+    return exactly the single-device predictions — layout only, never values."""
+    import jax
+
+    from unionml_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 4:
+        import pytest
+
+        pytest.skip("needs 4 devices (conftest forces 8 CPU devices)")
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+
+    plain = ResidentPredictor(_build_tokenized_model(), buckets=(4, 8), warmup=False)
+    plain.setup()
+    sharded = ResidentPredictor(
+        _build_tokenized_model(), buckets=(4, 8), warmup=False, mesh=mesh
+    )
+    sharded.setup()
+    assert sharded._compiled is not None
+    rows = [{"len": 3}, {"len": 5}, {"len": 2}]
+    want = np.asarray(plain.predict(features=rows))
+    got = np.asarray(sharded.predict(features=rows))
+    np.testing.assert_array_equal(got, want)
+    # the committed artifact lives on every mesh device
+    leaves = jax.tree_util.tree_leaves(sharded._device_model_object)
+    assert len(leaves[0].sharding.device_set) == 4

@@ -19,3 +19,16 @@ def shard_by_rules(params: Any, spec_for: Callable[[Tuple[str, ...], Any], Any])
         for path, leaf in flat
     ]
     return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def place_by_specs(params: Any, mesh: Any, spec_tree: Any) -> Any:
+    """Lay a parameter tree onto ``mesh`` per a matching ``PartitionSpec`` tree.
+
+    The serving-side counterpart of the trainer's ``jit(..., out_shardings=...)``
+    layout: parameters arrive as host (or single-device) arrays and are committed
+    to the mesh in one transfer, so the resident executables compile against
+    already-sharded weights instead of replicating them per call.
+    """
+    from unionml_tpu.parallel.mesh import named_sharding_tree
+
+    return jax.device_put(params, named_sharding_tree(mesh, spec_tree))

@@ -323,6 +323,24 @@ def init_cache(
     }
 
 
+def kv_cache_spec(config: GPTConfig, mesh_axis_names: Tuple[str, ...]) -> Any:
+    """PartitionSpec for KV-cache leaves ``(batch, heads, max_len, head_dim)``.
+
+    Serving shards the cache over attention HEADS on the ``tensor`` axis — the
+    same split :func:`param_shardings` gives the fused qkv kernel, so each
+    device holds exactly the K/V rows its attention shards produce and the
+    decode step runs without resharding the cache. Heads stay replicated when
+    the ``tensor`` axis is absent or does not divide the head count (a
+    wrong-divisor shard would silently pad heads).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from unionml_tpu.parallel.mesh import TENSOR_AXIS
+
+    tensor = TENSOR_AXIS if TENSOR_AXIS in mesh_axis_names else None
+    return P(None, tensor, None, None)
+
+
 def generate(
     model: GPTLMHeadModel,
     variables: Any,

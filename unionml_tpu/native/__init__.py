@@ -7,8 +7,10 @@ never hard-depends on the native build.
 
 import ctypes
 import os
+import shutil
 import subprocess
 import threading
+import time
 from pathlib import Path
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -38,6 +40,58 @@ def _build_dir() -> Path:
     return Path(os.getenv("UNIONML_TPU_HOME", Path.home() / ".unionml-tpu")) / "native"
 
 
+def _compile(lib_path: Path) -> None:
+    """Compile every native source into ``lib_path`` with the system toolchain."""
+    lib_path.parent.mkdir(parents=True, exist_ok=True)
+    subprocess.run(
+        [
+            "g++",
+            "-O3",
+            "-shared",
+            "-fPIC",
+            "-pthread",
+            "-std=c++17",
+            *[str(src) for src in _SOURCES],
+            "-o",
+            str(lib_path),
+        ],
+        check=True,
+        capture_output=True,
+    )
+    logger.info("Built native prefetcher -> %s", lib_path)
+
+
+def _build_and_load(lib_path: Path) -> ctypes.CDLL:
+    """Compile (when stale/missing) and dlopen the native library.
+
+    Raises ``subprocess.CalledProcessError`` / ``OSError`` on toolchain or
+    loader failure — the caller decides the fallback policy.
+    """
+    newest_src = max(src.stat().st_mtime for src in _SOURCES)
+    if not lib_path.exists() or lib_path.stat().st_mtime < newest_src:
+        _compile(lib_path)
+    return ctypes.CDLL(str(lib_path))
+
+
+def _rebuild_and_load_fresh(lib_path: Path) -> ctypes.CDLL:
+    """Replace a bad cached library and dlopen the REBUILT code in this process.
+
+    The canonical path gets the fresh build (future processes load it normally),
+    but glibc dedupes ``dlopen`` by pathname — reopening ``lib_path`` here would
+    hand back the stale mapping we are replacing — so this process maps the
+    healed build through a unique alias (unlinked immediately; the mapping
+    outlives the name).
+    """
+    lib_path.unlink(missing_ok=True)
+    _compile(lib_path)
+    alias = lib_path.with_name(f"{lib_path.stem}.heal-{os.getpid()}-{time.monotonic_ns()}.so")
+    try:
+        shutil.copy2(lib_path, alias)
+        return ctypes.CDLL(str(alias))
+    finally:
+        alias.unlink(missing_ok=True)
+
+
 def load_native_library() -> Optional[ctypes.CDLL]:
     """Build (once) and load the native library; None when unavailable."""
     global _lib, _build_failed
@@ -46,26 +100,7 @@ def load_native_library() -> Optional[ctypes.CDLL]:
             return _lib
         lib_path = _build_dir() / _LIB_NAME
         try:
-            newest_src = max(src.stat().st_mtime for src in _SOURCES)
-            if not lib_path.exists() or lib_path.stat().st_mtime < newest_src:
-                lib_path.parent.mkdir(parents=True, exist_ok=True)
-                subprocess.run(
-                    [
-                        "g++",
-                        "-O3",
-                        "-shared",
-                        "-fPIC",
-                        "-pthread",
-                        "-std=c++17",
-                        *[str(src) for src in _SOURCES],
-                        "-o",
-                        str(lib_path),
-                    ],
-                    check=True,
-                    capture_output=True,
-                )
-                logger.info("Built native prefetcher -> %s", lib_path)
-            lib = ctypes.CDLL(str(lib_path))
+            lib = _build_and_load(lib_path)
         except (subprocess.CalledProcessError, OSError, FileNotFoundError) as exc:
             detail = getattr(exc, "stderr", b"")
             logger.warning(
@@ -76,62 +111,84 @@ def load_native_library() -> Optional[ctypes.CDLL]:
             _build_failed = True
             return None
 
-        try:
-            lib.upf_create.restype = ctypes.c_void_p
-            lib.upf_create.argtypes = [
-                ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_long),
-                ctypes.POINTER(ctypes.c_long),
-                ctypes.POINTER(ctypes.c_long),
-                ctypes.c_long,
-                ctypes.c_long,
-            ]
-            lib.upf_start.argtypes = [
-                ctypes.c_void_p,
-                ctypes.POINTER(ctypes.c_long),
-                ctypes.c_long,
-                ctypes.c_long,
-                ctypes.c_long,
-                ctypes.c_long,
-                ctypes.POINTER(ctypes.c_void_p),
-            ]
-            lib.upf_next.restype = ctypes.c_long
-            lib.upf_next.argtypes = [ctypes.c_void_p]
-            lib.upf_release.argtypes = [ctypes.c_void_p, ctypes.c_long]
-            lib.upf_destroy.argtypes = [ctypes.c_void_p]
-            lib.upk_pack.restype = ctypes.c_longlong
-            lib.upk_pack.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_longlong,
-                ctypes.c_longlong,
-                ctypes.c_int32,
-                ctypes.c_longlong,
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32),
-            ]
-            lib.upk_count_rows.restype = ctypes.c_longlong
-            lib.upk_count_rows.argtypes = [
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_longlong,
-                ctypes.c_longlong,
-                ctypes.c_longlong,
-            ]
-        except AttributeError as exc:
-            # a stale cached library from an older package version can lack newer
-            # symbols while carrying a fresher mtime than the sources; missing
-            # symbols must degrade to the Python paths like every other failure
-            logger.warning(
-                "Native library at %s is missing symbols (%s); falling back to Python. "
-                "Delete the file to force a rebuild.",
-                lib_path,
-                exc,
-            )
-            _build_failed = True
-            return None
+        for attempt in (0, 1):
+            try:
+                _bind_symbols(lib)
+                break
+            except AttributeError as exc:
+                # a stale cached library from an older package version can lack
+                # newer symbols while carrying a fresher mtime than the sources
+                # (e.g. a reinstalled wheel). Self-heal: delete the cache and
+                # rebuild from the current sources ONCE before giving up.
+                if attempt == 0:
+                    logger.warning(
+                        "Native library at %s is missing symbols (%s); rebuilding from source.",
+                        lib_path,
+                        exc,
+                    )
+                    try:
+                        lib = _rebuild_and_load_fresh(lib_path)
+                        continue
+                    except (subprocess.CalledProcessError, OSError, FileNotFoundError) as build_exc:
+                        logger.warning(
+                            "Native rebuild failed (%s); falling back to Python.", build_exc
+                        )
+                else:
+                    logger.warning(
+                        "Rebuilt native library still missing symbols (%s); falling back to "
+                        "Python. Delete %s to force another rebuild.",
+                        exc,
+                        lib_path,
+                    )
+                _build_failed = True
+                return None
         _lib = lib
         return _lib
+
+
+def _bind_symbols(lib: ctypes.CDLL) -> None:
+    """Declare every C-ABI signature; AttributeError if any symbol is absent."""
+    lib.upf_create.restype = ctypes.c_void_p
+    lib.upf_create.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.c_long,
+    ]
+    lib.upf_start.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.upf_next.restype = ctypes.c_long
+    lib.upf_next.argtypes = [ctypes.c_void_p]
+    lib.upf_release.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.upf_destroy.argtypes = [ctypes.c_void_p]
+    lib.upk_pack.restype = ctypes.c_longlong
+    lib.upk_pack.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_longlong,
+        ctypes.c_longlong,
+        ctypes.c_int32,
+        ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.upk_count_rows.restype = ctypes.c_longlong
+    lib.upk_count_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_longlong,
+        ctypes.c_longlong,
+        ctypes.c_longlong,
+    ]
 
 
 def pack_sequences_native(
@@ -144,15 +201,29 @@ def pack_sequences_native(
     """First-fit packing through the native library; None when it is unavailable.
 
     Inputs are pre-normalized by :func:`unionml_tpu.ops.packing.pack_sequences`
-    (empties filtered, overlong sequences truncated, tokens concatenated), so
-    this wrapper only allocates worst-case outputs and slices to the row count
-    the C side reports. Output arrays are byte-identical to the Python path's.
+    (empties filtered, overlong sequences truncated, tokens concatenated); the
+    wrapper re-checks that ``lengths`` sums to ``flat_tokens.size`` (the C side
+    walks the buffer unchecked) and runs the two-pass protocol: count rows,
+    allocate exact outputs, pack. Output arrays are byte-identical to the
+    Python path's.
     """
     lib = load_native_library()
     if lib is None:
         return None
     flat_tokens = np.ascontiguousarray(flat_tokens, dtype=np.int32)
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    if int(lengths.sum()) != flat_tokens.size:
+        # the C side walks flat_tokens by the cumulative lengths with no bounds
+        # check of its own; a short buffer would be an out-of-bounds READ in
+        # upk_pack, so reject the call here and let the Python path (which
+        # indexes safely) surface whatever is wrong with the inputs
+        logger.warning(
+            "Native packer input mismatch: lengths sum to %d but flat_tokens has %d "
+            "tokens; using the Python path.",
+            int(lengths.sum()),
+            flat_tokens.size,
+        )
+        return None
     n_seqs = int(lengths.size)
     lengths_ptr = lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
     # two-pass protocol: count rows first, allocate EXACT outputs — a

@@ -13,7 +13,12 @@
 // by tests/unit/test_packing.py::test_native_packer_matches_python.
 //
 // C ABI (ctypes): caller pre-filters empty sequences, pre-truncates to seq_len,
-// concatenates tokens, and allocates worst-case (n_seqs rows, min 1) outputs.
+// and concatenates tokens; allocation follows the TWO-PASS exact protocol —
+// upk_count_rows first runs the identical first-fit placement loop to report
+// the exact row count, the caller allocates exactly that many (rows, seq_len)
+// output rows, then upk_pack fills them (and re-reports the row count, which
+// the caller cross-checks). No worst-case allocation anywhere: (n_seqs,
+// seq_len) x3 would be multi-GB at corpus scale.
 
 #include <cstddef>
 #include <cstdint>

@@ -32,6 +32,7 @@ from unionml_tpu.parallel.mesh import (
     logical_to_sharding,
     make_hybrid_mesh,
     make_mesh,
+    named_sharding_tree,
     replicated,
     shard_batch,
 )
@@ -60,6 +61,7 @@ __all__ = [
     "stage_sharding",
     "make_hybrid_mesh",
     "make_mesh",
+    "named_sharding_tree",
     "pad_to_multiple",
     "replicated",
     "ring_attention",

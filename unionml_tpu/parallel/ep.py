@@ -26,6 +26,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from unionml_tpu.parallel._compat import shard_map
+
 EXPERT_AXIS = "expert"
 
 
@@ -76,7 +78,7 @@ def moe_apply(
     body = functools.partial(
         _moe_local, expert_fn=expert_fn, axis_name=axis, experts_per_device=experts_per_device
     )
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(params_spec, P(), P()),
@@ -365,7 +367,7 @@ def moe_apply_a2a(
         capacity=capacity,
         normalize_gates=normalize_gates,
     )
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(params_spec, P(token_axes), P(token_axes)),

@@ -176,3 +176,18 @@ def logical_to_sharding(mesh: Mesh, *spec: Any) -> NamedSharding:
     """Convenience: ``PartitionSpec(*spec)`` bound to ``mesh``, dropping absent axes."""
     cleaned = tuple(s if (s is None or s in mesh.axis_names) else None for s in spec)
     return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+
+def named_sharding_tree(mesh: Mesh, spec_tree: Any) -> Any:
+    """Bind a ``PartitionSpec`` pytree to ``mesh`` as a matching ``NamedSharding`` tree.
+
+    The one place the spec->sharding tree_map lives: model ``param_shardings``
+    tables produce spec trees, and every consumer (train-state layout in the
+    driver, the sharded serving engine, the resident predictor) binds them to a
+    concrete mesh through this helper.
+    """
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from unionml_tpu.parallel._compat import shard_map
+
 STAGE_AXIS = "stage"
 
 
@@ -139,7 +141,7 @@ def pipeline_apply(
     body = functools.partial(
         _pipeline_local, stage_fn=body_fn, axis_name=axis, num_microbatches=num_microbatches
     )
-    out_mb = jax.shard_map(
+    out_mb = shard_map(
         body,
         mesh=mesh,
         in_specs=(params_spec, P(axis)),
@@ -274,7 +276,7 @@ def pipeline_apply_circular(
         num_microbatches=num_microbatches,
         rounds=rounds,
     )
-    out_mb = jax.shard_map(
+    out_mb = shard_map(
         body,
         mesh=mesh,
         in_specs=(params_spec, P(axis)),

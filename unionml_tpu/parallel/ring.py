@@ -23,6 +23,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from unionml_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 
+from unionml_tpu.parallel._compat import shard_map
+
 _NEG_INF = -1e30
 
 
@@ -133,7 +135,7 @@ def ring_attention(
     scale, spec, lens_spec, kv_lens = _sp_prologue(q, mesh, sm_scale, seq_axis, batch_axis, kv_lens)
 
     body = functools.partial(_ring_attention_local, axis_name=seq_axis, causal=causal, sm_scale=scale)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec, lens_spec),

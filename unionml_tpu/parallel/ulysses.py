@@ -25,6 +25,8 @@ from jax.sharding import Mesh, PartitionSpec as P  # noqa: F401 - P re-exported 
 from unionml_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 from unionml_tpu.parallel.ring import _sp_prologue
 
+from unionml_tpu.parallel._compat import shard_map
+
 
 def _ulysses_local(q, k, v, kv_lens, *, axis_name: str, causal: bool, sm_scale: float):
     # deferred: unionml_tpu.ops pulls in pallas, which only the sp hot path needs
@@ -70,6 +72,6 @@ def ulysses_attention(
     scale, spec, lens_spec, kv_lens = _sp_prologue(q, mesh, sm_scale, seq_axis, batch_axis, kv_lens)
 
     body = functools.partial(_ulysses_local, axis_name=seq_axis, causal=causal, sm_scale=scale)
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec, lens_spec), out_specs=spec, check_vma=False
     )(q, k, v, kv_lens)

@@ -89,12 +89,23 @@ def build_aiohttp_app(
     example_features: Optional[Any] = None,
     generator: Optional[Any] = None,
     generate_lookahead: int = 1,
+    mesh: Optional[Any] = None,
+    param_specs: Optional[Any] = None,
 ):
     """Create the aiohttp application with a resident predictor.
 
     ``coalesce=True`` merges concurrent row-list ``features`` requests into shared
     predictor calls (see :mod:`unionml_tpu.serving.batcher`); requests whose payloads
     don't fit the row-list contract fall back to per-request prediction.
+
+    ``mesh`` serves the resident predictor across a device mesh (see
+    :class:`ResidentPredictor`): parameters commit to the mesh at startup
+    (``param_specs`` lays them out, else replicated) and request batches shard
+    over the ``data`` axis. The endpoint contract (``/predict``, ``/health``,
+    ``/stats``) is unchanged above the sharded executor; for a mesh-sharded
+    ``/generate`` pass a ``generator`` built with ``DecodeEngine(..., mesh=...)``.
+    Under a mesh, coalesced flushes prefer multiples of the mesh's batch shards
+    so merged batches shard evenly instead of padding up.
 
     ``seq_buckets`` enables sequence-length bucketing for tokenized inputs, and
     ``example_features`` (a request-shaped row list) drives startup warmup for
@@ -119,6 +130,8 @@ def build_aiohttp_app(
             buckets=buckets or DEFAULT_BUCKETS,
             seq_buckets=seq_buckets,
             example_features=example_features,
+            mesh=mesh,
+            param_specs=param_specs,
         )
         if resident
         else None
@@ -127,8 +140,17 @@ def build_aiohttp_app(
     if coalesce and predictor is not None:
         from unionml_tpu.serving.batcher import RequestBatcher
 
+        preferred_multiple = None
+        if mesh is not None:
+            from unionml_tpu.parallel.mesh import batch_axis_size
+
+            n_shards = batch_axis_size(mesh)
+            preferred_multiple = n_shards if n_shards > 1 else None
         batcher = RequestBatcher(
-            lambda rows: predictor.predict(features=rows), max_batch=max_batch, max_wait_ms=max_wait_ms
+            lambda rows: predictor.predict(features=rows),
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            preferred_multiple=preferred_multiple,
         )
 
     async def on_startup(app):

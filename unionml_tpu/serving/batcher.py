@@ -26,17 +26,27 @@ class RequestBatcher:
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
         adaptive: bool = True,
+        preferred_multiple: Optional[int] = None,
     ):
         """
         ``adaptive=True`` keys the straggler wait on the observed arrival rate: when
         requests arrive sparsely (EMA inter-arrival gap above ``max_wait_ms``),
         waiting would add latency and coalesce nothing, so batches flush
         immediately; under bursts the full ``max_wait_ms`` window applies.
+
+        ``preferred_multiple`` (mesh-sharded predictors: the data-axis shard
+        count) grants one extra ``max_wait_ms`` straggler window when the drained
+        row count is not a multiple — a shard-even batch pads less after
+        bucketing — but never blocks a flush beyond that: correctness and the
+        bounded-latency contract are unchanged.
         """
         self._predict_rows = predict_rows
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
         self.adaptive = adaptive
+        self.preferred_multiple = (
+            int(preferred_multiple) if preferred_multiple and preferred_multiple > 1 else None
+        )
         self._ema_gap_s: Optional[float] = None
         self._last_arrival: Optional[float] = None
         self._queue: Optional[asyncio.Queue] = None
@@ -84,6 +94,7 @@ class RequestBatcher:
             pending = [(rows, future)]
             total = len(rows)
             deadline = asyncio.get_running_loop().time() + self._effective_wait_s()
+            topped_up = False
             while total < self.max_batch:
                 timeout = deadline - asyncio.get_running_loop().time()
                 if timeout <= 0:
@@ -97,13 +108,30 @@ class RequestBatcher:
                             break
                         pending.append((more_rows, more_future))
                         total += len(more_rows)
+                    if (
+                        self.preferred_multiple
+                        and not topped_up
+                        and total % self.preferred_multiple != 0
+                        and total < self.max_batch
+                    ):
+                        # mesh-sharded predictor: one extra window to reach a
+                        # shard-even row count, then flush regardless
+                        topped_up = True
+                        deadline = asyncio.get_running_loop().time() + self.max_wait_s
+                        continue
                     break
                 try:
                     more_rows, more_future = await asyncio.wait_for(self._queue.get(), timeout)
                 except asyncio.TimeoutError:
-                    break
+                    continue  # loop re-checks the deadline (and the top-up rule)
                 pending.append((more_rows, more_future))
                 total += len(more_rows)
+                if (
+                    self.preferred_multiple
+                    and topped_up
+                    and total % self.preferred_multiple == 0
+                ):
+                    break  # top-up reached a shard-even count: flush now
             await self._flush(pending)
 
     async def _flush(self, pending) -> None:

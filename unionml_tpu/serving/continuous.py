@@ -19,10 +19,24 @@ TPU-first shape discipline: everything the device sees is static.
   column counter, no gaps, no compaction; a freed slot is reusable immediately
   because a new request's mask (``k_pos <= position_r``) never reaches stale
   columns before its own decode overwrites them.
-- Prefill runs per request at batch 1, padded right to a small set of bucket
-  lengths (one compile per bucket), then one ``dynamic_update_slice`` per layer
-  copies the bucket into the slot's cache rows.
+- Prefill is BATCHED: queued prompts sharing a bucket prefill together, up to
+  ``prefill_batch`` rows per device dispatch (one compile per (rows, bucket)
+  shape, both ladders bounded), then one scatter per layer copies every row into
+  its slot's cache rows — N queued prompts admit in ⌈N/prefill_batch⌉ prefill
+  dispatches instead of N.
+- Long prompts optionally prefill in CHUNKS (``prefill_chunk``): one chunk of
+  the prompt runs per engine tick, interleaved between decode steps, so a
+  512-token prompt never stalls the in-flight decode batch for its whole
+  prefill.
 - The decode step jit-compiles exactly once per engine (all shapes fixed).
+
+Mesh-sharded serving (``mesh=``): the engine lays the model parameters out with
+the GPT family's Megatron-style ``param_shardings`` table and shards the KV
+cache over attention HEADS on the mesh's ``tensor`` axis, so ONE compiled decode
+step (and one compiled prefill) runs tensor-parallel across every device of the
+mesh — XLA inserts the all-reduces over ICI. Outputs are token-identical to the
+single-device engine; scheduling, admission, and the HTTP surface above are
+unchanged.
 
 ``DecodeEngine`` is the synchronous core (useful directly in scripts/tests);
 ``ContinuousBatcher`` runs it on a worker thread behind an asyncio API for the
@@ -77,6 +91,17 @@ class DecodeEngine:
         bound, so int8 weights halve the per-step weight traffic vs bf16;
         dequantization happens inside the compiled step and fuses into the
         matmuls. ``None`` (default) serves full-precision weights.
+    :param mesh: a ``jax.sharding.Mesh`` (see :mod:`unionml_tpu.parallel.mesh`)
+        for tensor-parallel serving: parameters shard Megatron-style
+        (:func:`unionml_tpu.models.gpt.param_shardings`), the KV cache shards
+        over attention heads on the ``tensor`` axis, and every compiled step runs
+        across all mesh devices. ``None`` (default) serves single-device.
+    :param prefill_batch: max prompts prefilled per device dispatch — queued
+        prompts sharing a bucket admit together, ⌈N/prefill_batch⌉ dispatches
+        for N prompts (one compile per (rows, bucket) shape).
+    :param prefill_chunk: when set, prompts longer than this prefill in chunks of
+        this many tokens, ONE chunk per engine tick between decode steps, so a
+        long prompt cannot stall in-flight decodes for its whole prefill.
     """
 
     def __init__(
@@ -91,6 +116,9 @@ class DecodeEngine:
         prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
         seed: int = 0,
         quantize: Optional[str] = None,
+        mesh: Optional[Any] = None,
+        prefill_batch: int = 4,
+        prefill_chunk: Optional[int] = None,
     ) -> None:
         from unionml_tpu.models.gpt import init_cache
 
@@ -103,6 +131,10 @@ class DecodeEngine:
             )
         if quantize not in (None, "int8"):
             raise ValueError(f"Unknown quantize mode {quantize!r}; expected None or 'int8'")
+        if quantize is not None and mesh is not None:
+            # the int8 tree's {q, scale} leaves have no entries in the sharding
+            # rule table; serving them sharded would silently replicate weights
+            raise ValueError("quantize and mesh are mutually exclusive (for now)")
         if quantize == "int8":
             from unionml_tpu.ops.quant import dequantize_tree, quantize_tree
 
@@ -111,6 +143,25 @@ class DecodeEngine:
         else:
             maybe_dequant = lambda tree: tree
 
+        self._mesh = mesh
+        self._cache_sharding = None
+        self._replicated = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from unionml_tpu.models._sharding import place_by_specs
+            from unionml_tpu.models.gpt import kv_cache_spec, param_shardings
+            from unionml_tpu.parallel.mesh import TENSOR_AXIS
+
+            spec_tree = param_shardings(variables, tuple(mesh.axis_names))
+            variables = place_by_specs(variables, mesh, spec_tree)
+            cache_spec = kv_cache_spec(config, tuple(mesh.axis_names))
+            tensor_size = int(mesh.shape[TENSOR_AXIS]) if TENSOR_AXIS in mesh.axis_names else 1
+            if config.num_heads % max(tensor_size, 1) != 0:
+                cache_spec = PartitionSpec()  # heads don't divide: replicate the cache
+            self._cache_sharding = NamedSharding(mesh, cache_spec)
+            self._replicated = NamedSharding(mesh, PartitionSpec())
+
         self._model = model
         self._variables = variables
         self._config = config
@@ -118,25 +169,45 @@ class DecodeEngine:
         self.max_len = max_len
         self.eos_token_id = eos_token_id
         self.temperature = float(temperature)
+        self.prefill_batch = max(1, int(prefill_batch))
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
         # a bucket equal to max_len is fine: prompts are < max_len and the padded
         # prefill occupies exactly the slot's cache columns
         self._buckets = tuple(sorted(b for b in prefill_buckets if b <= max_len)) or (max_len - 1,)
 
-        self._cache = init_cache(config, num_slots, max_len)
-        self._lens = jnp.zeros((num_slots,), jnp.int32)
-        self._last_logits = jnp.zeros((num_slots, config.vocab_size), jnp.float32)
         self._seed = seed
         self._resets = 0
-        self._key = jax.random.PRNGKey(seed)
 
         # host mirrors (authoritative for scheduling; device arrays follow them)
         self._active = np.zeros(num_slots, dtype=bool)
+        #: slots holding an in-progress chunked prefill: not active (no decode
+        #: yet), not free (their cache rows are being written)
+        self._reserved = np.zeros(num_slots, dtype=bool)
+        self._partials: Dict[int, Dict[str, Any]] = {}
         self._lens_host = np.zeros(num_slots, dtype=np.int64)
         self._remaining = np.zeros(num_slots, dtype=np.int64)
         # per-slot sampling controls (requests may override the engine defaults)
         self._slot_temp = np.full(num_slots, self.temperature, dtype=np.float32)
         self._slot_top_k = np.zeros(num_slots, dtype=np.int32)
         self._slot_top_p = np.ones(num_slots, dtype=np.float32)
+        #: device dispatches spent on prefill since construction (admission
+        #: batching makes this ⌈N/prefill_batch⌉ per N same-bucket prompts)
+        self.prefill_dispatches = 0
+
+        self._init_device_state()
+
+        cache_sharding = self._cache_sharding
+
+        def _constrain_cache(tree):
+            # keep the head-sharded layout pinned through every compiled program:
+            # propagation alone may let GSPMD re-layout the (donated) cache
+            if cache_sharding is None:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.lax.with_sharding_constraint(leaf, cache_sharding), tree
+            )
 
         def _decode_body(variables, cache, last_logits, lens, active, key, temp, top_k, top_p, *, sampling):
             """One decode step — the single shared body for the single-step fns AND
@@ -156,6 +227,7 @@ class DecodeEngine:
             else:
                 tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
             logits, cache = model.apply(variables, tokens[:, None], cache=cache, position=lens)
+            cache = _constrain_cache(cache)
             # inactive rows freeze: length and logits unchanged, their (ignored)
             # cache write lands on a column their own future prefill/decode rewrites
             new_lens = jnp.where(active, jnp.minimum(lens + 1, max_len - 1), lens)
@@ -174,25 +246,45 @@ class DecodeEngine:
         self._make_step = _make_step
         self._step_fns: Dict[bool, Any] = {}
 
-        def _prefill(variables, prompt_ids, length):
+        def _prefill(variables, prompt_ids, lengths):
+            """Batched bucket prefill: (rows, bucket) prompts, one device dispatch.
+
+            Rows are right-padded to the shared bucket; causal attention keeps
+            each row's logits at its last REAL token unaffected by the padded
+            tail (and by the other rows — rows are attention-independent).
+            """
             variables = maybe_dequant(variables)
-            local_cache = init_cache(config, 1, prompt_ids.shape[1])
+            rows, bucket = prompt_ids.shape
+            local_cache = init_cache(config, rows, bucket)
             logits, local_cache = model.apply(variables, prompt_ids, cache=local_cache, position=0)
-            # right padding + causal attention: the logits at the last REAL token
-            # are unaffected by the padded tail
-            return local_cache, jnp.take(logits[0], length - 1, axis=0)
+            idx = jnp.clip(lengths.astype(jnp.int32) - 1, 0, bucket - 1)
+            last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0, :]
+            return _constrain_cache(local_cache), last
 
-        self._prefill_fn = jax.jit(_prefill)  # re-traces per bucket shape (bounded)
+        self._prefill_fn = jax.jit(_prefill)  # re-traces per (rows, bucket) shape (bounded)
 
-        def _insert(cache, lens, last_logits, local_cache, local_logits, slot, length):
+        def _chunk_apply(variables, chunk_ids, local_cache, position):
+            """One chunk of a long prefill: attends over the cache prefix written
+            by earlier chunks (``position`` is traced — one compile per
+            (chunk, cache_len) shape, not per offset)."""
+            variables = maybe_dequant(variables)
+            logits, local_cache = model.apply(
+                variables, chunk_ids, cache=local_cache, position=position
+            )
+            return logits, _constrain_cache(local_cache)
+
+        self._chunk_fn = jax.jit(_chunk_apply, donate_argnums=(2,))
+
+        def _insert(cache, lens, last_logits, local_cache, local_logits, slots, lengths):
             def put(full, local):
-                return jax.lax.dynamic_update_slice(full, local.astype(full.dtype), (slot, 0, 0, 0))
+                width = local.shape[2]
+                return full.at[slots, :, :width, :].set(local.astype(full.dtype))
 
             cache = jax.tree_util.tree_map(put, cache, local_cache)
             return (
-                cache,
-                lens.at[slot].set(length),
-                last_logits.at[slot].set(local_logits.astype(jnp.float32)),
+                _constrain_cache(cache),
+                lens.at[slots].set(lengths.astype(lens.dtype)),
+                last_logits.at[slots].set(local_logits.astype(jnp.float32)),
             )
 
         self._insert_fn = jax.jit(_insert, donate_argnums=(0, 1, 2))
@@ -237,13 +329,35 @@ class DecodeEngine:
 
     # ------------------------------------------------------------------ scheduling
 
+    def _init_device_state(self) -> None:
+        """(Re)allocate the device-side state, laid out on the mesh when sharded."""
+        from unionml_tpu.models.gpt import init_cache
+
+        cache = init_cache(self._config, self.num_slots, self.max_len)
+        lens = jnp.zeros((self.num_slots,), jnp.int32)
+        last_logits = jnp.zeros((self.num_slots, self._config.vocab_size), jnp.float32)
+        key = jax.random.PRNGKey(self._seed + self._resets)
+        if self._mesh is not None:
+            cache = jax.device_put(cache, self._cache_sharding)
+            lens = jax.device_put(lens, self._replicated)
+            last_logits = jax.device_put(last_logits, self._replicated)
+            key = jax.device_put(key, self._replicated)
+        self._cache, self._lens, self._last_logits, self._key = cache, lens, last_logits, key
+
     @property
     def free_slots(self) -> List[int]:
-        return [int(s) for s in np.flatnonzero(~self._active)]
+        # reserved slots (chunked prefill in progress) are neither active nor free
+        return [int(s) for s in np.flatnonzero(~(self._active | self._reserved))]
 
     @property
     def num_active(self) -> int:
         return int(self._active.sum())
+
+    @property
+    def has_pending_prefill(self) -> bool:
+        """Whether any slot holds an in-progress chunked prefill (the engine must
+        keep ticking even with zero active decodes)."""
+        return bool(self._partials)
 
     def bucket_for(self, prompt_len: int) -> int:
         for bucket in self._buckets:
@@ -253,6 +367,41 @@ class DecodeEngine:
             f"prompt length {prompt_len} exceeds the largest prefill bucket "
             f"({self._buckets[-1]}); raise prefill_buckets/max_len or truncate"
         )
+
+    def validate_request(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        temperature: Optional[float] = None,
+        top_k: int = 0,
+        top_p: float = 1.0,
+    ) -> Tuple[np.ndarray, int, float, int, float]:
+        """Normalize one request, raising ``ValueError`` for anything the engine
+        cannot serve (empty/oversized prompt, bad budget or sampling controls).
+        Returns ``(prompt, budget, temperature, top_k, top_p)``."""
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size >= self.max_len:
+            raise ValueError(f"prompt length {prompt.size} >= max_len ({self.max_len})")
+        from unionml_tpu.ops.sampling import validate_sampling
+
+        temperature, top_k, top_p = validate_sampling(temperature, top_k, top_p)
+        temperature = self.temperature if temperature is None else temperature
+        self.bucket_for(prompt.size)  # raises for prompts beyond the bucket ladder
+        return prompt, int(max_new_tokens), float(temperature), int(top_k), float(top_p)
+
+    def _activate(self, slot: int, length: int, budget: int, temp: float, top_k: int, top_p: float) -> None:
+        self._active[slot] = True
+        self._reserved[slot] = False
+        self._lens_host[slot] = length
+        self._remaining[slot] = budget
+        self._slot_temp[slot] = temp
+        self._slot_top_k[slot] = top_k
+        self._slot_top_p[slot] = top_p
 
     def add_request(
         self,
@@ -274,39 +423,124 @@ class DecodeEngine:
         ``free_slots``) and ``ValueError`` for empty/oversized prompts. The
         effective budget is capped by cache capacity: generation force-finishes
         when the slot's length reaches ``max_len - 1``.
-        """
-        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
-        if prompt.size == 0:
-            raise ValueError("empty prompt")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        if prompt.size >= self.max_len:
-            raise ValueError(f"prompt length {prompt.size} >= max_len ({self.max_len})")
-        from unionml_tpu.ops.sampling import validate_sampling
 
-        temperature, top_k, top_p = validate_sampling(temperature, top_k, top_p)
-        temperature = self.temperature if temperature is None else temperature
+        The single-request form of :meth:`admit_many`.
+        """
+        return self.admit_many(
+            [(prompt_ids, max_new_tokens, dict(temperature=temperature, top_k=top_k, top_p=top_p))]
+        )[0]
+
+    def admit_many(self, requests: Sequence[Tuple]) -> List[int]:
+        """Admit several requests at once with BATCHED bucket prefills.
+
+        ``requests`` is a sequence of ``(prompt_ids, max_new_tokens)`` or
+        ``(prompt_ids, max_new_tokens, sampling_dict)``. Prompts sharing a
+        prefill bucket run through ONE (rows, bucket) prefill dispatch, up to
+        ``prefill_batch`` rows each — N queued prompts admit in
+        ⌈N/prefill_batch⌉ dispatches per bucket instead of N. Prompts longer
+        than ``prefill_chunk`` (when configured) admit as chunked prefills
+        advanced one chunk per :meth:`step` instead.
+
+        All requests validate BEFORE any device work (one bad request rejects
+        the call with nothing scheduled); ``RuntimeError`` when fewer slots are
+        free than requests. Returns the assigned slot per request, in order.
+        """
+        normalized = []
+        for req in requests:
+            prompt_ids, budget = req[0], req[1]
+            sampling = dict(req[2]) if len(req) > 2 and req[2] else {}
+            normalized.append(self.validate_request(prompt_ids, budget, **sampling))
         free = self.free_slots
-        if not free:
+        if len(normalized) > len(free):
             raise RuntimeError("no free decode slots")
-        slot = free[0]
-        bucket = self.bucket_for(prompt.size)
-        padded = np.zeros((1, bucket), dtype=np.int32)
-        padded[0, : prompt.size] = prompt
-        local_cache, local_logits = self._prefill_fn(
-            self._variables, jnp.asarray(padded), prompt.size
-        )
-        self._cache, self._lens, self._last_logits = self._insert_fn(
-            self._cache, self._lens, self._last_logits, local_cache, local_logits,
-            slot, prompt.size,
-        )
-        self._active[slot] = True
-        self._lens_host[slot] = prompt.size
-        self._remaining[slot] = max_new_tokens
-        self._slot_temp[slot] = temperature
-        self._slot_top_k[slot] = int(top_k)
-        self._slot_top_p[slot] = float(top_p)
-        return slot
+        slots = [free[i] for i in range(len(normalized))]
+
+        groups: Dict[int, List[int]] = {}
+        for i, (prompt, budget, temp, top_k, top_p) in enumerate(normalized):
+            if self._start_chunked(slots[i], prompt, budget, temp, top_k, top_p):
+                continue
+            groups.setdefault(self.bucket_for(prompt.size), []).append(i)
+
+        for bucket, idxs in groups.items():
+            for start in range(0, len(idxs), self.prefill_batch):
+                chunk = idxs[start : start + self.prefill_batch]
+                rows = len(chunk)
+                padded = np.zeros((rows, bucket), dtype=np.int32)
+                lengths = np.zeros((rows,), dtype=np.int32)
+                for r, i in enumerate(chunk):
+                    prompt = normalized[i][0]
+                    padded[r, : prompt.size] = prompt
+                    lengths[r] = prompt.size
+                local_cache, local_logits = self._prefill_fn(
+                    self._variables, jnp.asarray(padded), jnp.asarray(lengths)
+                )
+                self._cache, self._lens, self._last_logits = self._insert_fn(
+                    self._cache, self._lens, self._last_logits, local_cache, local_logits,
+                    jnp.asarray([slots[i] for i in chunk], dtype=jnp.int32),
+                    jnp.asarray(lengths),
+                )
+                self.prefill_dispatches += 1
+                for r, i in enumerate(chunk):
+                    _, budget, temp, top_k, top_p = normalized[i]
+                    self._activate(slots[i], int(lengths[r]), budget, temp, top_k, top_p)
+        return slots
+
+    # ------------------------------------------------------------- chunked prefill
+
+    def _start_chunked(self, slot: int, prompt: np.ndarray, budget: int,
+                       temp: float, top_k: int, top_p: float) -> bool:
+        """Reserve ``slot`` for a chunked prefill when the prompt qualifies.
+
+        Qualifies when ``prefill_chunk`` is configured, the prompt is longer than
+        one chunk, and the chunk-padded length still fits the slot's cache rows
+        (otherwise the bucketed batch path handles it)."""
+        chunk = self.prefill_chunk
+        if chunk is None or prompt.size <= chunk:
+            return False
+        padded_len = -(-prompt.size // chunk) * chunk
+        if padded_len > self.max_len:
+            return False
+        from unionml_tpu.models.gpt import init_cache
+
+        local_cache = init_cache(self._config, 1, padded_len)
+        if self._mesh is not None:
+            local_cache = jax.device_put(local_cache, self._cache_sharding)
+        self._reserved[slot] = True
+        self._partials[slot] = {
+            "prompt": prompt, "consumed": 0, "cache": local_cache,
+            "budget": budget, "temp": temp, "top_k": top_k, "top_p": top_p,
+        }
+        return True
+
+    def _advance_partials(self) -> None:
+        """Run ONE chunk of every in-progress chunked prefill (called per tick,
+        between decode dispatches); completed prefills insert + activate."""
+        for slot in list(self._partials):
+            state = self._partials[slot]
+            prompt, consumed = state["prompt"], state["consumed"]
+            chunk = self.prefill_chunk
+            take = min(chunk, prompt.size - consumed)
+            ids = np.zeros((1, chunk), dtype=np.int32)
+            ids[0, :take] = prompt[consumed : consumed + take]
+            logits, state["cache"] = self._chunk_fn(
+                self._variables, jnp.asarray(ids), state["cache"],
+                jnp.asarray(consumed, dtype=jnp.int32),
+            )
+            self.prefill_dispatches += 1
+            state["consumed"] = consumed + take
+            if state["consumed"] < prompt.size:
+                continue
+            # final chunk: logits at the prompt's last REAL token seed decoding
+            last = jnp.asarray(logits)[:, prompt.size - 1 - consumed, :]
+            self._cache, self._lens, self._last_logits = self._insert_fn(
+                self._cache, self._lens, self._last_logits, state["cache"], last,
+                jnp.asarray([slot], dtype=jnp.int32),
+                jnp.asarray([prompt.size], dtype=jnp.int32),
+            )
+            del self._partials[slot]
+            self._activate(
+                slot, prompt.size, state["budget"], state["temp"], state["top_k"], state["top_p"]
+            )
 
     def reset(self) -> None:
         """Reallocate device state and clear all slots.
@@ -316,16 +550,13 @@ class DecodeEngine:
         the state variables were already reassigned) leaves them poisoned and out
         of sync with the host mirrors. In-flight requests are abandoned.
         """
-        from unionml_tpu.models.gpt import init_cache
-
-        self._cache = init_cache(self._config, self.num_slots, self.max_len)
-        self._lens = jnp.zeros((self.num_slots,), jnp.int32)
-        self._last_logits = jnp.zeros((self.num_slots, self._config.vocab_size), jnp.float32)
         # the key is also a step output, so it is poisoned too; a fresh
         # reset-counted key keeps sampled streams from repeating the pre-crash run
         self._resets += 1
-        self._key = jax.random.PRNGKey(self._seed + self._resets)
+        self._init_device_state()
         self._active[:] = False
+        self._reserved[:] = False
+        self._partials.clear()
         self._lens_host[:] = 0
         self._remaining[:] = 0
         self._slot_temp[:] = self.temperature
@@ -360,6 +591,14 @@ class DecodeEngine:
         A device failure mid-step resets the engine (see :meth:`reset`) and
         re-raises; every in-flight request is lost but the engine stays usable.
         """
+        if self._partials:
+            # chunked prefills advance one chunk per tick, between decode
+            # dispatches, so long prompts never stall the in-flight batch
+            try:
+                self._advance_partials()
+            except Exception:
+                self.reset()
+                raise
         if not self._active.any():
             return []
         lookahead = max(1, int(lookahead))
@@ -434,10 +673,14 @@ class DecodeEngine:
     def abort_all(self) -> None:
         """Deactivate every slot (in-flight state is abandoned; cache reuse is safe)."""
         self._active[:] = False
+        self._reserved[:] = False
+        self._partials.clear()
 
     def cancel(self, slot: int) -> None:
         """Deactivate one slot (its request is abandoned; the slot is reusable)."""
         self._active[slot] = False
+        self._reserved[slot] = False
+        self._partials.pop(slot, None)
 
     def generate(
         self,
@@ -455,7 +698,8 @@ class DecodeEngine:
             prompt_ids, max_new_tokens, temperature=temperature, top_k=top_k, top_p=top_p
         )
         out: List[int] = []
-        while self._active[slot]:
+        # reserved = chunked prefill still in progress: keep ticking until done
+        while self._active[slot] or slot in self._partials:
             for event in self.step(lookahead):
                 if event.slot == slot and event.emit:
                     out.append(event.token)
@@ -609,17 +853,34 @@ class ContinuousBatcher:
     def _admit(self) -> None:
         while True:
             with self._lock:
-                if not self._pending or not self._engine.free_slots:
+                free = self._engine.free_slots
+                if not self._pending or not free:
                     return
-                prompt, budget, sampling, sink = self._pending.popleft()
-            if sink.cancelled:  # consumer gave up while queued
+                batch = [self._pending.popleft() for _ in range(min(len(self._pending), len(free)))]
+            admissible = []
+            for prompt, budget, sampling, sink in batch:
+                if sink.cancelled:  # consumer gave up while queued
+                    continue
+                try:
+                    self._engine.validate_request(prompt, budget, **sampling)
+                except Exception as exc:  # reject this request, keep serving others
+                    self._deliver(sink, "fail", exc)
+                    continue
+                admissible.append((prompt, budget, sampling, sink))
+            if not admissible:
                 continue
             try:
-                slot = self._engine.add_request(prompt, budget, **sampling)
-            except Exception as exc:  # reject this request, keep serving others
-                self._deliver(sink, "fail", exc)
+                # one admission call: same-bucket prompts share batched prefill
+                # dispatches (⌈N/prefill_batch⌉ per bucket, not N)
+                slots = self._engine.admit_many(
+                    [(prompt, budget, sampling) for prompt, budget, sampling, _ in admissible]
+                )
+            except Exception as exc:  # device-side failure: fail this batch, keep serving
+                for *_, sink in admissible:
+                    self._deliver(sink, "fail", exc)
                 continue
-            self._sinks[slot] = sink
+            for slot, (*_, sink) in zip(slots, admissible):
+                self._sinks[slot] = sink
 
     def _run(self) -> None:
         while True:
@@ -627,6 +888,17 @@ class ContinuousBatcher:
                 if self._closed and not self._pending and not self._sinks:
                     return
             self._admit()
+            if self._engine.num_active == 0 and self._engine.has_pending_prefill:
+                # chunked prefills need ticks even with nothing decoding
+                try:
+                    self._engine.step()
+                except Exception as exc:
+                    logger.exception("chunked-prefill tick failed")
+                    for sink in self._sinks.values():
+                        self._deliver(sink, "fail", RuntimeError(str(exc)))
+                    self._sinks.clear()
+                    self._engine.abort_all()
+                continue
             if self._engine.num_active == 0:
                 self._work.clear()
                 # re-check under the flag: a request may have landed just now

@@ -26,6 +26,8 @@ def attach_fastapi(
     buckets: Optional[Any] = None,
     seq_buckets: Optional[Any] = None,
     example_features: Optional[Any] = None,
+    mesh: Optional[Any] = None,
+    param_specs: Optional[Any] = None,
     **unsupported: Any,
 ) -> FastAPI:
     from unionml_tpu.serving.resident import DEFAULT_BUCKETS
@@ -43,6 +45,10 @@ def attach_fastapi(
             buckets=buckets or DEFAULT_BUCKETS,
             seq_buckets=seq_buckets,
             example_features=example_features,
+            # the mesh-sharded executor sits entirely below the endpoint
+            # contract: /predict and /health behave identically above it
+            mesh=mesh,
+            param_specs=param_specs,
         )
         if resident
         else None

@@ -58,11 +58,22 @@ class ResidentPredictor:
         warmup: bool = True,
         seq_buckets: Optional[Sequence[int]] = None,
         example_features: Optional[Any] = None,
+        mesh: Optional[Any] = None,
+        param_specs: Optional[Any] = None,
     ):
+        """``mesh`` (a ``jax.sharding.Mesh``) serves the compiled predictor across
+        every mesh device: the model artifact commits to the mesh once at setup —
+        laid out by ``param_specs`` (a ``PartitionSpec`` pytree matching the model
+        object, e.g. a family's ``param_shardings`` table) or replicated when
+        ``None`` — and request batches shard their leading dim over the ``data``
+        axis when the padded bucket divides. Outputs are identical to the
+        single-device predictor; only the layout changes."""
         self._model = model
         self._buckets = tuple(sorted(buckets))
         self._seq_buckets = tuple(sorted(seq_buckets)) if seq_buckets else None
         self._example_features = example_features
+        self._mesh = mesh
+        self._param_specs = param_specs
         self._warmup = warmup
         self._compiled = None
         self._device_model_object = None
@@ -103,8 +114,21 @@ class ResidentPredictor:
         model_object = artifact.model_object
         if is_jax_compatible(model_object):
             predictor_fn = getattr(predictor, "fn", predictor)
-            # keep the artifact resident on device: no host->device transfer per request
-            self._device_model_object = jax.tree_util.tree_map(jax.numpy.asarray, model_object)
+            if self._mesh is not None:
+                # mesh-resident artifact: parameters commit to every mesh device
+                # once (sharded per param_specs, else replicated); the compiled
+                # predictor then runs tensor/data-parallel across the mesh
+                from unionml_tpu.parallel.mesh import named_sharding_tree, replicated
+
+                shardings = (
+                    named_sharding_tree(self._mesh, self._param_specs)
+                    if self._param_specs is not None
+                    else replicated(self._mesh)
+                )
+                self._device_model_object = jax.device_put(model_object, shardings)
+            else:
+                # keep the artifact resident on device: no host->device transfer per request
+                self._device_model_object = jax.tree_util.tree_map(jax.numpy.asarray, model_object)
             self._compiled = jax.jit(predictor_fn)
             if self._warmup:
                 self._warm()
@@ -206,8 +230,24 @@ class ResidentPredictor:
                     pad[1] = (0, seq_bucket - seq)
             if any(p != (0, 0) for p in pad):
                 a = np.pad(np.asarray(a), pad)
-            padded.append(jax.numpy.asarray(a))
+            padded.append(self._to_device(a, bucket))
         return jax.tree_util.tree_unflatten(treedef, padded), n, bucket
+
+    def _to_device(self, leaf: Any, bucket: int) -> Any:
+        """Place one padded leaf: batch-sharded over the mesh's data axis when the
+        bucket divides evenly (per-row work fans out), replicated otherwise;
+        plain single-device transfer without a mesh."""
+        if self._mesh is None:
+            return jax.numpy.asarray(leaf)
+        from unionml_tpu.parallel.mesh import batch_axis_size, batch_sharding, replicated
+
+        n_shards = batch_axis_size(self._mesh)
+        sharding = (
+            batch_sharding(self._mesh)
+            if n_shards > 1 and bucket % n_shards == 0
+            else replicated(self._mesh)
+        )
+        return jax.device_put(leaf, sharding)
 
     # ------------------------------------------------------------------ request path
 
