@@ -25,3 +25,31 @@ def gpt_tiny_session():
     model = GPTLMHeadModel(config)
     variables = init_params(config, seq_len=16)
     return config, model, variables
+
+
+@pytest.fixture(scope="session")
+def gpt_tiny_solo(gpt_tiny_session):
+    """Memoized reference completions over the session GPT: ``solo(prompt, n)``.
+
+    The engine suites all compare against the one-shot ``models.gpt.generate``
+    path; each distinct (prompt, n, sampling) tuple re-traces the generate scan,
+    so session-scoping + memoization pays each reference exactly once for the
+    whole run (test_prefix_cache replays the same prompts many times across
+    hit/miss/evict/mesh schedules)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from unionml_tpu.models.gpt import generate
+
+    _, model, variables = gpt_tiny_session
+    memo = {}
+
+    def solo(prompt, n, **sampling):
+        key = (tuple(int(t) for t in prompt), int(n), tuple(sorted(sampling.items())))
+        if key not in memo:
+            ids = jnp.asarray(np.asarray(prompt, dtype=np.int32)[None])
+            out = generate(model, variables, ids, n, **sampling)
+            memo[key] = [int(t) for t in np.asarray(out)[0, len(prompt):]]
+        return memo[key]
+
+    return solo

@@ -170,6 +170,30 @@ def test_step_failure_after_state_assignment_recovers_key(gpt):
     assert engine.generate([3, 1, 4], 5) == solo(model, variables, [3, 1, 4], 5)
 
 
+def test_cancel_mid_chunked_prefill_frees_slot_for_reuse(gpt):
+    """Cancelling a slot with a chunked prefill IN PROGRESS (chunks already
+    advanced, not merely queued) must drop the partial entirely: the slot
+    returns to free_slots, a subsequent admit_many reuses it, and the new
+    request's stream matches a fresh engine exactly."""
+    model, variables = gpt
+    engine = DecodeEngine(
+        model, variables, num_slots=1, max_len=64, prefill_buckets=(16,), prefill_chunk=4
+    )
+    (slot,) = engine.admit_many([(list(range(1, 11)), 5)])
+    engine.step()  # advance ONE chunk: the partial now holds device state
+    assert engine.has_pending_prefill and engine._partials[slot]["consumed"] > 0
+    engine.cancel(slot)
+    assert not engine.has_pending_prefill
+    assert not engine._partials and engine.free_slots == [slot]
+
+    (slot2,) = engine.admit_many([([3, 1, 4], 4)])
+    assert slot2 == slot  # the cancelled partial's slot is genuinely reusable
+    out = []
+    while engine.num_active:
+        out.extend(ev.token for ev in engine.step() if ev.emit)
+    assert out == solo(model, variables, [3, 1, 4], 4)
+
+
 def test_bucket_equal_to_max_len_is_usable(gpt):
     model, variables = gpt
     engine = DecodeEngine(model, variables, num_slots=1, max_len=16, prefill_buckets=(16,))

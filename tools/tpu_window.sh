@@ -6,8 +6,9 @@
 #   1. bench.py            — headline BERT-base fine-tune throughput + MFU
 #   2. bench_kernels.py    — pallas-vs-XLA block sweep -> KERNEL_BENCH.json
 #   3. bench_serving.py    — HTTP p50/p99 -> SERVING_BENCH.json, plus the
-#                            prefill-heavy admission mix and (--mesh 4) the
-#                            tensor-parallel sharded-engine serving path
+#                            prefill-heavy admission mix, the prefix-heavy
+#                            shared-prompt mix (KV prefix cache on/off), and
+#                            (--mesh 4) the tensor-parallel sharded-engine path
 # Each step's JSON artifact is committed by the caller if it changed.
 set -u
 cd "$(dirname "$0")/.."
@@ -64,7 +65,7 @@ run kernels 900 python bench_kernels.py
 run packed 600 python bench_kernels.py --packed
 # distill sweep winners into the dispatch overlay (no-op without timing-valid runs)
 run promote 60 python tools/promote_tuning.py
-run serving 540 python bench_serving.py --bert-base --speculative --prefill-heavy
+run serving 600 python bench_serving.py --bert-base --speculative --prefill-heavy --prefix-heavy
 # tensor-parallel serving path (sharded DecodeEngine + batched/chunked prefill):
 # times the mesh-sharded generate + prefill-mix phases only (cheap, focused)
 run serving_mesh 420 python bench_serving.py --mesh 4

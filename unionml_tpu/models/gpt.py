@@ -341,6 +341,77 @@ def kv_cache_spec(config: GPTConfig, mesh_axis_names: Tuple[str, ...]) -> Any:
     return P(None, tensor, None, None)
 
 
+def init_block_pool(
+    config: GPTConfig, num_blocks: int, block_size: int, dtype: Any = None
+) -> Dict[str, Any]:
+    """Zeroed KV block pool for prefix caching: ``(num_blocks, heads, block_size,
+    head_dim)`` per layer, the serving engine's reuse store for prompt-prefix KV.
+
+    Heads sit on the same axis as :func:`init_cache` leaves, so the pool shards
+    with the identical head-sharded spec (:func:`kv_block_spec`) and pool↔slot
+    copies stay shard-local on a mesh (gather/scatter over the unsharded block
+    axis only).
+    """
+    dtype = dtype if dtype is not None else config.dtype
+    shape = (num_blocks, config.num_heads, block_size, config.head_dim)
+    return {
+        f"layer_{i}": {
+            "k": jnp.zeros(shape, dtype=dtype),
+            "v": jnp.zeros(shape, dtype=dtype),
+        }
+        for i in range(config.num_layers)
+    }
+
+
+def kv_block_spec(config: GPTConfig, mesh_axis_names: Tuple[str, ...]) -> Any:
+    """PartitionSpec for KV block-pool leaves ``(blocks, heads, block_size,
+    head_dim)``: heads on ``tensor``, exactly like :func:`kv_cache_spec`, so
+    restoring a pool block into a slot's cache rows never reshards."""
+    return kv_cache_spec(config, mesh_axis_names)
+
+
+def gather_block_prefix(pool: Dict[str, Any], block_ids: jax.Array, pad_len: int) -> Dict[str, Any]:
+    """(jit-traceable) Gather pool blocks into a batch-1 cache holding the prefix.
+
+    ``block_ids`` is ``(n,)``; the result is a cache pytree of ``(1, heads,
+    pad_len, head_dim)`` leaves whose first ``n * block_size`` columns are the
+    gathered blocks in order (the rest zero, to be written by the suffix
+    prefill). The gather indexes the unsharded block axis, so under a
+    head-sharded mesh layout the copy is shard-local.
+    """
+
+    def gather(leaf):
+        blocks = leaf[block_ids]  # (n, heads, block_size, head_dim)
+        n, heads, block_size, head_dim = blocks.shape
+        prefix = jnp.moveaxis(blocks, 0, 1).reshape(heads, n * block_size, head_dim)
+        out = jnp.zeros((1, heads, pad_len, head_dim), leaf.dtype)
+        return out.at[0, :, : n * block_size, :].set(prefix)
+
+    return jax.tree_util.tree_map(gather, pool)
+
+
+def slice_cache_blocks(
+    cache: Dict[str, Any], row: jax.Array, start_block: jax.Array, num_blocks: int, block_size: int
+) -> Dict[str, Any]:
+    """(jit-traceable) Slice blocks ``[start, start + num_blocks)`` of one cache
+    row into pool layout ``(num_blocks, heads, block_size, head_dim)`` per layer.
+
+    ``row`` and ``start_block`` may be traced scalars (one compile per
+    ``num_blocks`` count, not per slot or offset); the slice covers cache
+    columns ``[start_block * block_size, (start_block + num_blocks) * block_size)``.
+    """
+
+    def take(leaf):
+        r = leaf[row]  # (heads, max_len, head_dim)
+        heads, _, head_dim = r.shape
+        src = jax.lax.dynamic_slice_in_dim(
+            r, start_block * block_size, num_blocks * block_size, axis=1
+        )
+        return jnp.moveaxis(src.reshape(heads, num_blocks, block_size, head_dim), 1, 0)
+
+    return jax.tree_util.tree_map(take, cache)
+
+
 def generate(
     model: GPTLMHeadModel,
     variables: Any,

@@ -4,6 +4,7 @@ from typing import Any, Optional
 
 from unionml_tpu.serving.app import build_aiohttp_app, jsonable, load_model_artifact, run_app
 from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+from unionml_tpu.serving.prefix_cache import PrefixCache
 from unionml_tpu.serving.speculative import SpeculativeBatcher
 from unionml_tpu.serving.resident import ResidentPredictor
 
@@ -58,6 +59,7 @@ def serving_app(
 __all__ = [
     "ContinuousBatcher",
     "DecodeEngine",
+    "PrefixCache",
     "ResidentPredictor",
     "build_aiohttp_app",
     "jsonable",

@@ -89,6 +89,8 @@ def build_aiohttp_app(
     example_features: Optional[Any] = None,
     generator: Optional[Any] = None,
     generate_lookahead: int = 1,
+    generate_prefix_cache_blocks: int = 0,
+    generate_prefix_block_size: int = 16,
     mesh: Optional[Any] = None,
     param_specs: Optional[Any] = None,
 ):
@@ -118,6 +120,14 @@ def build_aiohttp_app(
     the model artifact loads, so the engine can be built from trained variables.
     ``generate_lookahead`` sets the decode steps fused per device dispatch when
     the app wraps a bare engine (see :meth:`DecodeEngine.step`).
+
+    ``generate_prefix_cache_blocks`` > 0 enables KV **prefix caching** on the
+    served engine at startup (``generate_prefix_block_size`` tokens per block,
+    see :meth:`DecodeEngine.enable_prefix_cache`) unless the engine already has
+    one: requests sharing a prompt prefix (system prompts, chat history)
+    restore its KV from a device block pool and prefill only their suffix.
+    Cache hit/eviction counters surface under ``GET /stats`` →
+    ``generation.prefix_cache``.
     """
     from aiohttp import web
 
@@ -163,6 +173,12 @@ def build_aiohttp_app(
             built = generator() if callable(generator) and not isinstance(
                 generator, (DecodeEngine, ContinuousBatcher)
             ) else generator
+            if generate_prefix_cache_blocks:
+                target = built.engine if isinstance(built, ContinuousBatcher) else built
+                if isinstance(target, DecodeEngine) and target.prefix_cache is None:
+                    target.enable_prefix_cache(
+                        generate_prefix_cache_blocks, generate_prefix_block_size
+                    )
             if isinstance(built, DecodeEngine):
                 built = ContinuousBatcher(built, lookahead=generate_lookahead)
             app["continuous_batcher"] = built
@@ -354,6 +370,13 @@ def build_aiohttp_app(
                 "active": gen.engine.num_active,
                 "max_len": gen.engine.max_len,
             }
+            if getattr(gen.engine, "prefix_cache", None) is not None:
+                # hit rate + eviction churn for the KV prefix cache, plus the
+                # engine's FLOP counter the hits shrink
+                payload["generation"]["prefix_cache"] = gen.engine.prefix_cache.stats()
+                payload["generation"]["prefill_tokens_computed"] = (
+                    gen.engine.prefill_tokens_computed
+                )
         if batcher is not None:
             payload["coalescing"] = dict(batcher.stats)
             if batcher.ema_gap_ms is not None:

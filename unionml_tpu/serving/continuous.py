@@ -28,6 +28,13 @@ TPU-first shape discipline: everything the device sees is static.
   the prompt runs per engine tick, interleaved between decode steps, so a
   512-token prompt never stalls the in-flight decode batch for its whole
   prefill.
+- PREFIX CACHING (``prefix_cache_blocks``): completed prompts index their KV
+  into a device-side block pool behind a host radix tree
+  (:mod:`unionml_tpu.serving.prefix_cache`); an admitted prompt's longest
+  cached prefix is restored with one shard-local gather instead of recomputed,
+  and only the uncovered suffix runs through prefill — under shared-prefix
+  traffic (system prompts, few-shot templates, chat history) prefill FLOPs
+  drop by the shared fraction while outputs stay token-identical.
 - The decode step jit-compiles exactly once per engine (all shapes fixed).
 
 Mesh-sharded serving (``mesh=``): the engine lays the model parameters out with
@@ -102,6 +109,18 @@ class DecodeEngine:
     :param prefill_chunk: when set, prompts longer than this prefill in chunks of
         this many tokens, ONE chunk per engine tick between decode steps, so a
         long prompt cannot stall in-flight decodes for its whole prefill.
+    :param prefix_cache_blocks: when > 0, enable PREFIX CACHING with a device
+        KV block pool of this many blocks (see :meth:`enable_prefix_cache`):
+        completed prompts index their KV block-by-block into a host radix tree
+        (:class:`~unionml_tpu.serving.prefix_cache.PrefixCache`), and admission
+        restores each prompt's longest cached prefix instead of recomputing it
+        — only the uncovered suffix prefills. ``0`` (default) disables caching.
+    :param prefix_block_size: tokens per cached KV block (match granularity and
+        pool-copy unit); prefixes match in whole blocks only.
+    :param prefix_cache_generated: also index a retiring slot's GENERATED
+        tokens' KV, so a multi-turn follow-up prompt (previous prompt +
+        completion + new text) hits the whole previous turn, not just its
+        prompt.
     """
 
     def __init__(
@@ -119,6 +138,9 @@ class DecodeEngine:
         mesh: Optional[Any] = None,
         prefill_batch: int = 4,
         prefill_chunk: Optional[int] = None,
+        prefix_cache_blocks: int = 0,
+        prefix_block_size: int = 16,
+        prefix_cache_generated: bool = False,
     ) -> None:
         from unionml_tpu.models.gpt import init_cache
 
@@ -195,8 +217,25 @@ class DecodeEngine:
         #: device dispatches spent on prefill since construction (admission
         #: batching makes this ⌈N/prefill_batch⌉ per N same-bucket prompts)
         self.prefill_dispatches = 0
+        #: REAL prompt tokens run through prefill compute (padding excluded);
+        #: prefix-cache hits shrink this to the uncovered suffix per request —
+        #: the FLOP counter the prefix-heavy bench and its CI test assert on
+        self.prefill_tokens_computed = 0
+        #: pool→slot prefix restores / slot→pool block saves dispatched
+        self.prefix_restore_dispatches = 0
+        self.prefix_save_dispatches = 0
+
+        # prefix cache (disabled until enable_prefix_cache): host radix index +
+        # device KV block pool + per-slot held node paths / token transcripts
+        self.prefix_cache: Optional[Any] = None
+        self.prefix_cache_generated = bool(prefix_cache_generated)
+        self._prefix_block_size = int(prefix_block_size)
+        self._pool: Optional[Any] = None
+        self._slot_path: Dict[int, List[Any]] = {}
+        self._slot_tokens: Dict[int, List[int]] = {}
 
         self._init_device_state()
+        self._sync_sampling_mirrors()
 
         cache_sharding = self._cache_sharding
 
@@ -289,6 +328,36 @@ class DecodeEngine:
 
         self._insert_fn = jax.jit(_insert, donate_argnums=(0, 1, 2))
 
+        def _restore(pool, block_ids, pad_len):
+            """Gather cached prefix blocks into a fresh batch-1 local cache
+            (columns beyond the prefix zero, written by the suffix prefill).
+            The gather indexes the unsharded block axis: shard-local on a mesh."""
+            from unionml_tpu.models.gpt import gather_block_prefix
+
+            return _constrain_cache(gather_block_prefix(pool, block_ids, pad_len))
+
+        # one compile per (n_blocks, pad_len) — both from small bounded ladders
+        self._restore_fn = jax.jit(_restore, static_argnums=(2,))
+
+        def _save(pool, cache, row, start_block, dst_ids, block_size):
+            """Scatter one slot's cache blocks [start, start+n) into the pool at
+            ``dst_ids``; row/start are traced (one compile per block count)."""
+            from unionml_tpu.models.gpt import slice_cache_blocks
+
+            blocks = slice_cache_blocks(cache, row, start_block, dst_ids.shape[0], block_size)
+
+            def put(pool_leaf, blk):
+                return pool_leaf.at[dst_ids].set(blk.astype(pool_leaf.dtype))
+
+            return _constrain_cache(jax.tree_util.tree_map(put, pool, blocks))
+
+        self._save_fn = jax.jit(_save, static_argnums=(5,), donate_argnums=(0,))
+
+        if prefix_cache_blocks:
+            self.enable_prefix_cache(
+                prefix_cache_blocks, prefix_block_size, cache_generated=prefix_cache_generated
+            )
+
         def _make_multi_step(n_steps: int, sampling: bool):
             """K decode steps fused into one device program (``lax.scan``).
 
@@ -343,6 +412,44 @@ class DecodeEngine:
             last_logits = jax.device_put(last_logits, self._replicated)
             key = jax.device_put(key, self._replicated)
         self._cache, self._lens, self._last_logits, self._key = cache, lens, last_logits, key
+
+    def _sync_sampling_mirrors(self) -> None:
+        """Refresh the device mirrors of the per-slot sampling controls.
+
+        Called only where the host arrays mutate (:meth:`_activate`,
+        :meth:`reset`) — the decode step reuses the mirrors instead of paying a
+        host→device conversion of all three vectors every tick.
+        """
+        self._temp_dev = jnp.asarray(self._slot_temp)
+        self._top_k_dev = jnp.asarray(self._slot_top_k)
+        self._top_p_dev = jnp.asarray(self._slot_top_p)
+
+    def enable_prefix_cache(
+        self, num_blocks: int, block_size: int = 16, *, cache_generated: bool = False
+    ) -> None:
+        """Allocate the prefix cache: a host radix index over token-id blocks
+        plus a device KV block pool of ``num_blocks`` blocks of ``block_size``
+        tokens, laid out with the slot cache's head-sharded spec under a mesh
+        (pool↔slot copies stay shard-local). ``cache_generated`` also indexes a
+        retiring slot's generated tokens for multi-turn reuse. Callable once,
+        either via the constructor (``prefix_cache_blocks=``) or after
+        construction (serving-app plumbing)."""
+        from unionml_tpu.models.gpt import init_block_pool
+        from unionml_tpu.serving.prefix_cache import PrefixCache
+
+        if self.prefix_cache is not None:
+            raise RuntimeError("prefix cache is already enabled on this engine")
+        block_size = int(block_size)
+        if not 1 <= block_size < self.max_len:
+            raise ValueError(
+                f"prefix_block_size must be in [1, max_len) = [1, {self.max_len}), got {block_size}"
+            )
+        self.prefix_cache = PrefixCache(int(num_blocks), block_size)
+        self.prefix_cache_generated = bool(cache_generated)
+        self._prefix_block_size = block_size
+        self._pool = init_block_pool(self._config, int(num_blocks), block_size)
+        if self._mesh is not None:
+            self._pool = jax.device_put(self._pool, self._cache_sharding)
 
     @property
     def free_slots(self) -> List[int]:
@@ -402,6 +509,9 @@ class DecodeEngine:
         self._slot_temp[slot] = temp
         self._slot_top_k[slot] = top_k
         self._slot_top_p[slot] = top_p
+        # the ONE place (besides reset) the sampling controls mutate: refresh
+        # their device mirrors here so step() never re-uploads them per tick
+        self._sync_sampling_mirrors()
 
     def add_request(
         self,
@@ -444,6 +554,12 @@ class DecodeEngine:
         All requests validate BEFORE any device work (one bad request rejects
         the call with nothing scheduled); ``RuntimeError`` when fewer slots are
         free than requests. Returns the assigned slot per request, in order.
+
+        With the prefix cache enabled, admission is TWO-PASS: a request whose
+        prefix a same-call sibling is about to index (detected on host, by
+        token-block comparison) defers to a second pass and restores that KV
+        instead of recomputing it — a cold burst of N same-prefix prompts pays
+        ONE full prefill plus N-1 suffixes, not N full prefills.
         """
         normalized = []
         for req in requests:
@@ -456,19 +572,55 @@ class DecodeEngine:
         slots = [free[i] for i in range(len(normalized))]
 
         groups: Dict[int, List[int]] = {}
-        for i, (prompt, budget, temp, top_k, top_p) in enumerate(normalized):
-            if self._start_chunked(slots[i], prompt, budget, temp, top_k, top_p):
-                continue
-            groups.setdefault(self.bucket_for(prompt.size), []).append(i)
+        deferred: List[int] = []
+        sibling_prefixes: set = set()
+        for i, norm in enumerate(normalized):
+            prompt = norm[0]
+            if self.prefix_cache is not None:
+                if self._defer_for_sibling(prompt, sibling_prefixes):
+                    deferred.append(i)
+                    continue
+                self._note_prefixes(prompt, sibling_prefixes)
+            self._admit_one(slots[i], norm, groups)
+        self._flush_groups(groups, normalized, slots)
+        if deferred:
+            # the siblings' blocks are indexed now: deferred requests re-match
+            # and admit as hits (or fall back cleanly if the pool filled up)
+            groups = {}
+            for i in deferred:
+                self._admit_one(slots[i], normalized[i], groups)
+            self._flush_groups(groups, normalized, slots)
+        return slots
 
+    def _admit_one(self, slot: int, norm: Tuple, groups: Dict[int, List[int]]) -> None:
+        """Route one validated request: chunked prefill, one-shot prefix-cache
+        hit, or the batched bucket path (queued in ``groups`` for
+        :meth:`_flush_groups`). Prefix matching happens here so the chunked and
+        one-shot paths both see the restored-prefix length."""
+        prompt, budget, temp, top_k, top_p = norm
+        path, matched = self._match_prefix(prompt)
+        if self._start_chunked(slot, prompt, budget, temp, top_k, top_p, path, matched):
+            return
+        if matched and self._admit_with_prefix(
+            slot, prompt, budget, temp, top_k, top_p, path, matched
+        ):
+            return
+        groups.setdefault(self.bucket_for(prompt.size), []).append(slot)
+
+    def _flush_groups(
+        self, groups: Dict[int, List[int]], normalized: Sequence[Tuple], slots: Sequence[int]
+    ) -> None:
+        """Run the batched bucket prefills: per bucket, up to ``prefill_batch``
+        rows per device dispatch, then one scatter into the slot cache rows."""
+        slot_to_norm = {slot: norm for slot, norm in zip(slots, normalized)}
         for bucket, idxs in groups.items():
             for start in range(0, len(idxs), self.prefill_batch):
                 chunk = idxs[start : start + self.prefill_batch]
                 rows = len(chunk)
                 padded = np.zeros((rows, bucket), dtype=np.int32)
                 lengths = np.zeros((rows,), dtype=np.int32)
-                for r, i in enumerate(chunk):
-                    prompt = normalized[i][0]
+                for r, slot in enumerate(chunk):
+                    prompt = slot_to_norm[slot][0]
                     padded[r, : prompt.size] = prompt
                     lengths[r] = prompt.size
                 local_cache, local_logits = self._prefill_fn(
@@ -476,38 +628,175 @@ class DecodeEngine:
                 )
                 self._cache, self._lens, self._last_logits = self._insert_fn(
                     self._cache, self._lens, self._last_logits, local_cache, local_logits,
-                    jnp.asarray([slots[i] for i in chunk], dtype=jnp.int32),
+                    jnp.asarray(chunk, dtype=jnp.int32),
                     jnp.asarray(lengths),
                 )
                 self.prefill_dispatches += 1
-                for r, i in enumerate(chunk):
-                    _, budget, temp, top_k, top_p = normalized[i]
-                    self._activate(slots[i], int(lengths[r]), budget, temp, top_k, top_p)
-        return slots
+                for r, slot in enumerate(chunk):
+                    prompt, budget, temp, top_k, top_p = slot_to_norm[slot]
+                    self._activate(slot, int(lengths[r]), budget, temp, top_k, top_p)
+                    self.prefill_tokens_computed += int(prompt.size)
+                    self._index_prompt(slot, prompt)
+
+    def _defer_for_sibling(self, prompt: np.ndarray, sibling_prefixes: set) -> bool:
+        """True when an earlier request in THIS admit_many call is about to
+        index a longer block-prefix of ``prompt`` than the tree matches today —
+        deferring lets this request restore that KV instead of recomputing it."""
+        block = self._prefix_block_size
+        max_blocks = (int(prompt.size) - 1) // block
+        for k in range(max_blocks, 0, -1):
+            if tuple(int(t) for t in prompt[: k * block]) in sibling_prefixes:
+                return k > self.prefix_cache.probe(prompt, max_blocks)
+        return False
+
+    def _note_prefixes(self, prompt: np.ndarray, sibling_prefixes: set) -> None:
+        """Record every block-prefix this request will index once it prefills
+        (its full blocks), for :meth:`_defer_for_sibling` checks that follow."""
+        block = self._prefix_block_size
+        for k in range(1, int(prompt.size) // block + 1):
+            sibling_prefixes.add(tuple(int(t) for t in prompt[: k * block]))
+
+    # -------------------------------------------------------------- prefix cache
+
+    def _match_prefix(self, prompt: np.ndarray) -> Tuple[List[Any], int]:
+        """Longest cached full-block prefix of ``prompt``; ``([], 0)`` when the
+        cache is disabled or nothing matches. Matching is capped one token short
+        of the prompt: at least one real token must run prefill to produce the
+        ``last_logits`` that seed decoding. The returned node path is
+        reference-held until the slot retires (or admission declines the hit).
+        """
+        if self.prefix_cache is None:
+            return [], 0
+        max_blocks = (int(prompt.size) - 1) // self._prefix_block_size
+        if max_blocks <= 0:
+            return [], 0
+        path = self.prefix_cache.match(prompt, max_blocks)
+        return path, len(path) * self._prefix_block_size
+
+    def _admit_with_prefix(
+        self, slot: int, prompt: np.ndarray, budget: int,
+        temp: float, top_k: int, top_p: float, path: List[Any], matched: int,
+    ) -> bool:
+        """One-shot admission of a prefix-cache hit: restore the matched blocks
+        into a batch-1 local cache (shard-local gather), prefill ONLY the
+        uncovered suffix over it (bucket-padded, the chunk program), insert into
+        the slot. The match shrinks block-by-block if the suffix bucket would
+        overflow the slot's cache rows; returns False (path fully released) when
+        nothing survives, and the caller falls back to the batched bucket path.
+        """
+        block = self._prefix_block_size
+        while matched and matched + self.bucket_for(prompt.size - matched) > self.max_len:
+            self.prefix_cache.release([path.pop()])
+            matched -= block
+        if not matched:
+            return False
+        suffix_len = int(prompt.size) - matched
+        bucket = self.bucket_for(suffix_len)
+        pad_len = matched + bucket  # exact: the suffix write never clamps
+        block_ids = jnp.asarray([node.block_id for node in path], dtype=jnp.int32)
+        local_cache = self._restore_fn(self._pool, block_ids, pad_len)
+        self.prefix_restore_dispatches += 1
+        ids = np.zeros((1, bucket), dtype=np.int32)
+        ids[0, :suffix_len] = prompt[matched:]
+        logits, local_cache = self._chunk_fn(
+            self._variables, jnp.asarray(ids), local_cache,
+            jnp.asarray(matched, dtype=jnp.int32),
+        )
+        self.prefill_dispatches += 1
+        self.prefill_tokens_computed += suffix_len
+        last = jnp.asarray(logits)[:, suffix_len - 1, :]
+        self._cache, self._lens, self._last_logits = self._insert_fn(
+            self._cache, self._lens, self._last_logits, local_cache, last,
+            jnp.asarray([slot], dtype=jnp.int32),
+            jnp.asarray([prompt.size], dtype=jnp.int32),
+        )
+        self.prefix_cache.record_hit(matched)
+        self._activate(slot, int(prompt.size), budget, temp, top_k, top_p)
+        self._slot_path[slot] = path
+        self._index_prompt(slot, prompt)
+        return True
+
+    def _index_prompt(self, slot: int, prompt: np.ndarray) -> None:
+        """Index a freshly prefilled prompt's KV into the pool (all its full
+        blocks) and start the slot's token transcript when generated-KV capture
+        is on. Runs AFTER :meth:`_activate`, on every admission path."""
+        if self.prefix_cache is None:
+            return
+        if self.prefix_cache_generated:
+            self._slot_tokens[slot] = [int(t) for t in prompt]
+        self._extend_index(slot, prompt)
+
+    def _extend_index(self, slot: int, tokens: np.ndarray) -> None:
+        """Extend the slot's held radix path over ``tokens``' full blocks and
+        device-copy KV for the NEW blocks out of the slot's cache rows."""
+        path = self._slot_path.pop(slot, [])
+        full, new = self.prefix_cache.extend(
+            path, tokens, int(tokens.size) // self._prefix_block_size
+        )
+        if new:
+            start = len(full) - len(new)  # new nodes are always the path's tail
+            dst = jnp.asarray([node.block_id for node in new], dtype=jnp.int32)
+            self._pool = self._save_fn(
+                self._pool, self._cache, jnp.asarray(slot, dtype=jnp.int32),
+                jnp.asarray(start, dtype=jnp.int32), dst, self._prefix_block_size,
+            )
+            self.prefix_save_dispatches += 1
+        if full:
+            self._slot_path[slot] = full
+
+    def _capture_generated(self, slot: int) -> None:
+        """At retirement (``prefix_cache_generated``): index the slot's FULL
+        token transcript — prompt plus every decoded token, eos included — so a
+        multi-turn follow-up hits the whole previous turn. Cache columns map
+        1:1 to transcript positions; the valid count is the slot's length."""
+        tokens = self._slot_tokens.get(slot)
+        if not tokens:
+            return
+        valid = int(self._lens_host[slot])
+        self._extend_index(slot, np.asarray(tokens[:valid], dtype=np.int32))
+
+    def _release_prefix(self, slot: int) -> None:
+        """Drop the slot's references into the radix tree (retirement/cancel)."""
+        path = self._slot_path.pop(slot, None)
+        if path and self.prefix_cache is not None:
+            self.prefix_cache.release(path)
+        self._slot_tokens.pop(slot, None)
 
     # ------------------------------------------------------------- chunked prefill
 
     def _start_chunked(self, slot: int, prompt: np.ndarray, budget: int,
-                       temp: float, top_k: int, top_p: float) -> bool:
+                       temp: float, top_k: int, top_p: float,
+                       path: Sequence[Any] = (), matched: int = 0) -> bool:
         """Reserve ``slot`` for a chunked prefill when the prompt qualifies.
 
-        Qualifies when ``prefill_chunk`` is configured, the prompt is longer than
-        one chunk, and the chunk-padded length still fits the slot's cache rows
-        (otherwise the bucketed batch path handles it)."""
+        Qualifies when ``prefill_chunk`` is configured, the UNCOVERED part of
+        the prompt (``matched`` tokens restore from the prefix cache) is longer
+        than one chunk, and the padded length still fits the slot's cache rows
+        (otherwise the one-shot hit / bucketed batch paths handle it). With a
+        hit, the local cache starts as the restored prefix and chunking resumes
+        at ``consumed = matched``; the pad length anchors at ``matched`` so the
+        final chunk's cache write never clamps."""
         chunk = self.prefill_chunk
-        if chunk is None or prompt.size <= chunk:
+        if chunk is None or prompt.size - matched <= chunk:
             return False
-        padded_len = -(-prompt.size // chunk) * chunk
+        padded_len = matched + -(-(prompt.size - matched) // chunk) * chunk
         if padded_len > self.max_len:
             return False
-        from unionml_tpu.models.gpt import init_cache
+        if matched:
+            block_ids = jnp.asarray([node.block_id for node in path], dtype=jnp.int32)
+            local_cache = self._restore_fn(self._pool, block_ids, padded_len)
+            self.prefix_restore_dispatches += 1
+            self.prefix_cache.record_hit(matched)
+            self._slot_path[slot] = list(path)
+        else:
+            from unionml_tpu.models.gpt import init_cache
 
-        local_cache = init_cache(self._config, 1, padded_len)
-        if self._mesh is not None:
-            local_cache = jax.device_put(local_cache, self._cache_sharding)
+            local_cache = init_cache(self._config, 1, padded_len)
+            if self._mesh is not None:
+                local_cache = jax.device_put(local_cache, self._cache_sharding)
         self._reserved[slot] = True
         self._partials[slot] = {
-            "prompt": prompt, "consumed": 0, "cache": local_cache,
+            "prompt": prompt, "consumed": matched, "cache": local_cache,
             "budget": budget, "temp": temp, "top_k": top_k, "top_p": top_p,
         }
         return True
@@ -527,6 +816,7 @@ class DecodeEngine:
                 jnp.asarray(consumed, dtype=jnp.int32),
             )
             self.prefill_dispatches += 1
+            self.prefill_tokens_computed += int(take)
             state["consumed"] = consumed + take
             if state["consumed"] < prompt.size:
                 continue
@@ -541,6 +831,7 @@ class DecodeEngine:
             self._activate(
                 slot, prompt.size, state["budget"], state["temp"], state["top_k"], state["top_p"]
             )
+            self._index_prompt(slot, prompt)
 
     def reset(self) -> None:
         """Reallocate device state and clear all slots.
@@ -562,11 +853,28 @@ class DecodeEngine:
         self._slot_temp[:] = self.temperature
         self._slot_top_k[:] = 0
         self._slot_top_p[:] = 1.0
+        self._sync_sampling_mirrors()
+        if self.prefix_cache is not None:
+            # the pool is donated by block saves, so a failed save can poison it
+            # just like the cache: reallocate and forget every cached prefix
+            from unionml_tpu.models.gpt import init_block_pool
+
+            self.prefix_cache.clear()
+            self._slot_path.clear()
+            self._slot_tokens.clear()
+            self._pool = init_block_pool(
+                self._config, self.prefix_cache.num_blocks, self._prefix_block_size
+            )
+            if self._mesh is not None:
+                self._pool = jax.device_put(self._pool, self._cache_sharding)
 
     def _apply_token(self, slot: int, token: int) -> StepEvent:
         """Advance the host mirrors for one decoded token (same rules as on device)."""
         self._remaining[slot] -= 1
         self._lens_host[slot] = min(self._lens_host[slot] + 1, self.max_len - 1)
+        tokens = self._slot_tokens.get(slot)
+        if tokens is not None:  # generated-KV capture: eos included, emit or not
+            tokens.append(int(token))
         is_eos = self.eos_token_id is not None and token == self.eos_token_id
         finished = (
             is_eos
@@ -575,6 +883,10 @@ class DecodeEngine:
         )
         if finished:
             self._active[slot] = False
+            if self.prefix_cache is not None:
+                if self.prefix_cache_generated:
+                    self._capture_generated(slot)
+                self._release_prefix(slot)
         return StepEvent(slot=slot, token=token, emit=not is_eos, finished=finished)
 
     def step(self, lookahead: int = 1) -> List[StepEvent]:
@@ -615,12 +927,14 @@ class DecodeEngine:
             if needed < lookahead:
                 lookahead = min(lookahead, 1 << (needed - 1).bit_length())
         # the all-greedy program skips the sampling machinery; heterogeneous slots
-        # share the sampling program with per-row controls
+        # share the sampling program with per-row controls. The control vectors
+        # ride as device mirrors refreshed only when _activate/reset mutate them
+        # — not re-uploaded per tick; activity changes every step, so it uploads.
         sampling = bool((self._slot_temp[self._active] > 0).any())
         active_dev = jnp.asarray(self._active)
-        temp_dev = jnp.asarray(self._slot_temp)
-        top_k_dev = jnp.asarray(self._slot_top_k)
-        top_p_dev = jnp.asarray(self._slot_top_p)
+        temp_dev = self._temp_dev
+        top_k_dev = self._top_k_dev
+        top_p_dev = self._top_p_dev
         if lookahead == 1:
             fn = self._step_fns.get(sampling)
             if fn is None:
@@ -657,8 +971,9 @@ class DecodeEngine:
                 self._variables, self._cache, self._last_logits, self._lens,
                 active_dev, remaining_dev, self._key, temp_dev, top_k_dev, top_p_dev,
             )
-            tokens_host = np.asarray(jax.device_get(tokens))
-            masks_host = np.asarray(jax.device_get(masks))
+            # ONE hard sync for the whole burst: fetching tokens and masks
+            # separately would pay the host round-trip twice per scan
+            tokens_host, masks_host = map(np.asarray, jax.device_get((tokens, masks)))
         except Exception:
             self.reset()
             raise
@@ -675,12 +990,16 @@ class DecodeEngine:
         self._active[:] = False
         self._reserved[:] = False
         self._partials.clear()
+        for slot in list(self._slot_path):
+            self._release_prefix(slot)
+        self._slot_tokens.clear()
 
     def cancel(self, slot: int) -> None:
         """Deactivate one slot (its request is abandoned; the slot is reusable)."""
         self._active[slot] = False
         self._reserved[slot] = False
         self._partials.pop(slot, None)
+        self._release_prefix(slot)
 
     def generate(
         self,

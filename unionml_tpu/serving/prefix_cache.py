@@ -1,0 +1,208 @@
+"""Host-side radix tree over token-id blocks for KV prefix caching.
+
+Prompt-heavy serving traffic is dominated by shared prefixes — system prompts,
+few-shot templates, chat history — and recomputing their prefill per request
+burns the FLOPs that bound throughput. The serving engine keeps computed KV for
+prompt prefixes in a device-side block pool; THIS module is the host-side index
+over that pool: a radix tree whose edges are fixed-size blocks of token ids,
+mapping a prompt's longest cached prefix to the pool block ids holding its KV.
+
+Design (the vLLM/SGLang radix-cache discipline, block-granular):
+
+- **Block granularity.** A node caches exactly ``block_size`` tokens' KV in one
+  pool block; matching walks whole blocks, so a prompt sharing 10 tokens of a
+  cached prefix at ``block_size=4`` restores 8 (a partial-block hit) and
+  prefills the rest.
+- **Refcounts.** Every matched/inserted path is acquired until the using slot
+  retires; referenced nodes are never evicted, so a block can always be trusted
+  while a restore or a multi-turn follow-up depends on it.
+- **LRU eviction.** Allocation prefers the free list, then evicts the
+  least-recently-used *leaf* with zero references (leaves only: an interior
+  evict would orphan descendants whose match path runs through it).
+
+The tree is pure host Python (no jax import): the engine owns the device pool
+and performs the gather/scatter copies; this index only decides WHICH blocks
+hold WHAT tokens and WHEN a block may be reused.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    """One cached block: ``key`` (the block's token ids) under ``parent``."""
+
+    __slots__ = ("key", "block_id", "parent", "children", "refcount", "last_used")
+
+    def __init__(self, key: Tuple[int, ...], block_id: int, parent: Optional["_Node"]) -> None:
+        self.key = key
+        self.block_id = block_id
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.refcount = 0
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Block-granular radix index mapping token-id prefixes to pool block ids.
+
+    :param num_blocks: capacity of the device block pool this index manages.
+    :param block_size: tokens cached per block (match/insert granularity).
+
+    Protocol (driven by :class:`~unionml_tpu.serving.continuous.DecodeEngine`):
+    :meth:`match` walks the longest cached chain of full blocks for a prompt and
+    acquires a reference on every matched node; after the uncovered suffix
+    prefills, :meth:`extend` indexes the prompt's remaining full blocks
+    (allocating pool blocks, evicting LRU unreferenced leaves as needed) and the
+    caller device-copies KV into the NEW blocks it returns. :meth:`release`
+    drops the path's references when the slot retires. Counters
+    (:meth:`stats`) make the hit rate and eviction churn observable.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._root = _Node((), -1, None)
+        # pop() takes from the tail: keep ids ascending for readable tests/logs
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._tick = 0
+        self.lookups = 0
+        self.hits = 0
+        self.hit_tokens = 0
+        self.inserted_blocks = 0
+        self.evicted_blocks = 0
+
+    @property
+    def cached_blocks(self) -> int:
+        """Pool blocks currently holding indexed KV."""
+        return self.num_blocks - len(self._free)
+
+    def _key_at(self, tokens: Sequence[int], block_index: int) -> Tuple[int, ...]:
+        start = block_index * self.block_size
+        return tuple(int(t) for t in tokens[start : start + self.block_size])
+
+    def match(self, tokens: Sequence[int], max_blocks: int) -> List[_Node]:
+        """Longest cached chain of full blocks covering ``tokens``, up to
+        ``max_blocks``. Bumps recency and ACQUIRES a reference on every matched
+        node — callers must :meth:`release` the returned path when done."""
+        self._tick += 1
+        self.lookups += 1
+        node, path = self._root, []  # type: ignore[var-annotated]
+        while len(path) < max_blocks:
+            child = node.children.get(self._key_at(tokens, len(path)))
+            if child is None:
+                break
+            child.last_used = self._tick
+            child.refcount += 1
+            path.append(child)
+            node = child
+        return path
+
+    def probe(self, tokens: Sequence[int], max_blocks: int) -> int:
+        """Length (in blocks) :meth:`match` would return — WITHOUT acquiring
+        references or touching recency/counters. Used by admission scheduling
+        to compare a live match against what a same-batch sibling will insert."""
+        node, depth = self._root, 0
+        while depth < max_blocks:
+            child = node.children.get(self._key_at(tokens, depth))
+            if child is None:
+                break
+            depth += 1
+            node = child
+        return depth
+
+    def record_hit(self, matched_tokens: int) -> None:
+        """Count one served hit of ``matched_tokens`` restored-prefix tokens
+        (called by the engine with the FINAL matched length, after any
+        capacity-driven shrink, so counters reflect KV actually reused)."""
+        if matched_tokens > 0:
+            self.hits += 1
+            self.hit_tokens += int(matched_tokens)
+
+    def extend(
+        self, path: List[_Node], tokens: Sequence[int], max_blocks: int
+    ) -> Tuple[List[_Node], List[_Node]]:
+        """Index ``tokens``' full blocks beyond ``path``, up to ``max_blocks``.
+
+        Existing nodes (a sibling indexed them first) are acquired in place; a
+        missing node allocates a pool block — evicting the LRU unreferenced
+        leaf when the free list is empty — and is returned in ``new`` for the
+        caller to device-copy KV into. Stops early (keeping the indexed chain a
+        true prefix) when every pool block is referenced. Returns
+        ``(full_path, new_nodes)``; ``new_nodes`` is always the tail of
+        ``full_path``, and every node of ``full_path`` holds a reference the
+        caller must eventually :meth:`release`.
+        """
+        self._tick += 1
+        node = path[-1] if path else self._root
+        full, new = list(path), []  # type: ignore[var-annotated]
+        while len(full) < max_blocks:
+            key = self._key_at(tokens, len(full))
+            child = node.children.get(key)
+            if child is None:
+                block_id = self._alloc()
+                if block_id is None:  # every block referenced: cannot evict
+                    break
+                child = _Node(key, block_id, node)
+                node.children[key] = child
+                new.append(child)
+                self.inserted_blocks += 1
+            child.last_used = self._tick
+            child.refcount += 1
+            full.append(child)
+            node = child
+        return full, new
+
+    def release(self, path: Sequence[_Node]) -> None:
+        """Drop one reference from every node of ``path`` (slot retirement)."""
+        for node in path:
+            node.refcount -= 1
+
+    def clear(self) -> None:
+        """Forget every cached block (engine reset: the pool is reallocated)."""
+        self._root = _Node((), -1, None)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+
+    def _alloc(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim = self._lru_leaf()
+        if victim is None:
+            return None
+        self._evict(victim)
+        return self._free.pop()
+
+    def _lru_leaf(self) -> Optional[_Node]:
+        best: Optional[_Node] = None
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            elif node.refcount <= 0 and (best is None or node.last_used < best.last_used):
+                best = node
+        return best
+
+    def _evict(self, node: _Node) -> None:
+        assert node.parent is not None and not node.children
+        del node.parent.children[node.key]
+        self._free.append(node.block_id)
+        self.evicted_blocks += 1
+
+    def stats(self) -> Dict[str, int]:
+        """Counters for /stats and the prefix-heavy bench."""
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "cached_blocks": self.cached_blocks,
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "hit_tokens": self.hit_tokens,
+            "inserted_blocks": self.inserted_blocks,
+            "evicted_blocks": self.evicted_blocks,
+        }
