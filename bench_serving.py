@@ -557,6 +557,80 @@ def bench_prefix_heavy(n_requests: int = 0, shared_len: int = 0, suffix_len: int
     }
 
 
+def bench_pipeline(modes=("on", "off"), n_requests: int = 8, max_new_tokens: int = 64,
+                   mesh_devices: int = 0):
+    """Depth-1 pipelined decode A/B: dispatch-ahead ON vs OFF, same engine
+    config and workload (``bench_serving.py --pipeline {on,off,ab}``).
+
+    The pipelining payoff is the HOST GAP: with pipelining off the device
+    idles from each token fetch until the host has applied tokens, admitted
+    requests, and dispatched the next step; with depth-1 dispatch-ahead the
+    next step is already queued when the host starts that work, so the gap
+    collapses to ~0. Reported per mode: decode tok/s, ``ema_host_gap_ms``
+    (ms the device queue sat empty before a dispatch), ``ema_fetch_block_ms``
+    (host time blocked in the token fetch), and the idle-dispatch fraction —
+    engine-level (no HTTP jitter), lookahead=1 (the latency-serving shape
+    where the per-tick host sync dominates).
+    """
+    config, model, variables = _bench_gpt()
+    mesh = _serving_mesh(mesh_devices, config.num_heads) if mesh_devices else None
+
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config.vocab_size, size=6).tolist() for _ in range(n_requests)]
+
+    def run(pipelined: bool):
+        engine = DecodeEngine(
+            model, variables, num_slots=min(8, n_requests), max_len=128,
+            prefill_buckets=(8,), mesh=mesh, pipeline=pipelined,
+        )
+        engine.generate(prompts[0], 4)  # warm the prefill/decode programs
+        # warmup out of the books: the timed run owns the EMAs and counters
+        engine.ema_host_gap_ms = engine.ema_fetch_block_ms = None
+        engine.step_dispatches = engine.idle_dispatches = 0
+        base_tokens = engine.tokens_decoded
+        pending = list(prompts)
+        t0 = time.perf_counter()
+        while pending or engine.num_active or engine.has_pending_events:
+            free = len(engine.free_slots)
+            if pending and free:
+                wave, pending = pending[:free], pending[free:]
+                engine.admit_many([(p, max_new_tokens) for p in wave])
+            engine.step()
+        elapsed = time.perf_counter() - t0
+        decoded = engine.tokens_decoded - base_tokens
+        return {
+            "decode_tok_s": round(decoded / elapsed, 1),
+            "total_s": round(elapsed, 4),
+            "tokens": decoded,
+            "ema_host_gap_ms": round(engine.ema_host_gap_ms or 0.0, 3),
+            "ema_fetch_block_ms": round(engine.ema_fetch_block_ms or 0.0, 3),
+            "idle_dispatch_frac": round(
+                engine.idle_dispatches / max(engine.step_dispatches, 1), 3
+            ),
+        }
+
+    out = {
+        "n_requests": n_requests,
+        "max_new_tokens": max_new_tokens,
+        "lookahead": 1,
+        "mesh_devices": mesh_devices or 1,
+    }
+    for mode in modes:
+        out["pipeline_" + mode] = run(mode == "on")
+    if "pipeline_on" in out and "pipeline_off" in out:
+        out["host_gap_reduction_ms"] = round(
+            out["pipeline_off"]["ema_host_gap_ms"] - out["pipeline_on"]["ema_host_gap_ms"], 3
+        )
+        out["speedup_tok_s"] = round(
+            out["pipeline_on"]["decode_tok_s"]
+            / max(out["pipeline_off"]["decode_tok_s"], 1e-9),
+            3,
+        )
+    return out
+
+
 def bench_speculative(iters: int = 20, max_new_tokens: int = 32, gamma: int = 4):
     """Speculative vs plain single-stream /generate latency over real HTTP.
 
@@ -631,6 +705,13 @@ def main():
                         help="also bench the prefix-heavy mix (N requests sharing a K-token "
                         "prefix): KV prefix-cache ON vs OFF — prefill tokens recomputed, "
                         "cache hit rate, prefill dispatches")
+    parser.add_argument("--pipeline", choices=("on", "off", "ab"), default=None,
+                        help="focused depth-1 pipelined-decode phase: decode tok/s + "
+                        "host-gap ms at lookahead=1 with dispatch-ahead on/off "
+                        "('ab' runs the pair and reports the delta). Runs ONLY this "
+                        "phase (like --mesh) so the hardware-window battery can time "
+                        "the A/B without re-paying the MLP/BERT benches; combine with "
+                        "--mesh N to run it over an N-device mesh")
     parser.add_argument(
         "--out",
         default="SERVING_BENCH.json",
@@ -645,11 +726,15 @@ def main():
     from bench_util import resolve_artifact_path
 
     backend = jax.default_backend()
-    if args.mesh:
+    if args.pipeline or args.mesh:
         import os
 
         base, ext = os.path.splitext(args.out)
-        args.out = f"{base}_mesh{args.mesh}{ext}"
+        if args.pipeline:
+            base = f"{base}_pipeline"
+        if args.mesh:
+            base = f"{base}_mesh{args.mesh}"
+        args.out = f"{base}{ext}"
     args.out = resolve_artifact_path(args.out, backend)
     results = {
         "backend": backend,
@@ -657,6 +742,29 @@ def main():
         "cold_start_excluded": True,
         "models": {},
     }
+
+    if args.pipeline:
+        if args.mesh and len(jax.devices()) < args.mesh:
+            print(json.dumps({"metric": "pipeline_decode_tok_s",
+                              "error": f"--mesh {args.mesh} needs {args.mesh} devices, "
+                              f"found {len(jax.devices())}", "backend": backend}))
+            return 1
+        modes = ("on", "off") if args.pipeline == "ab" else (args.pipeline,)
+        ab = bench_pipeline(modes=modes, mesh_devices=args.mesh)
+        results["models"]["pipeline_ab" if len(modes) == 2 else f"pipeline_{modes[0]}"] = ab
+        line = {"metric": "pipeline_decode_tok_s", "backend": backend,
+                "mesh_devices": args.mesh or 1}
+        for mode in modes:
+            line[f"tok_s_{mode}"] = ab[f"pipeline_{mode}"]["decode_tok_s"]
+            line[f"host_gap_ms_{mode}"] = ab[f"pipeline_{mode}"]["ema_host_gap_ms"]
+        if len(modes) == 2:
+            line["host_gap_reduction_ms"] = ab["host_gap_reduction_ms"]
+            line["speedup_tok_s"] = ab["speedup_tok_s"]
+        print(json.dumps(line))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        return 0
 
     if args.mesh:
         if len(jax.devices()) < args.mesh:

@@ -139,7 +139,7 @@ def test_step_failure_resets_engine(gpt):
     def exploding(*args, **kwargs):
         raise RuntimeError("synthetic device failure")
 
-    engine._step_fns = {False: exploding, True: exploding}
+    engine._step_fns = {(1, False): exploding, (1, True): exploding}
     with pytest.raises(RuntimeError, match="synthetic device failure"):
         engine.step()
     engine._step_fns = {}
@@ -161,7 +161,7 @@ def test_step_failure_after_state_assignment_recovers_key(gpt):
         engine._key = object()  # stands in for a poisoned device array
         raise RuntimeError("deferred device failure")
 
-    engine._step_fns = {False: poisoning, True: poisoning}
+    engine._step_fns = {(1, False): poisoning, (1, True): poisoning}
     with pytest.raises(RuntimeError, match="deferred device failure"):
         engine.step()
     engine._step_fns = {}
@@ -269,6 +269,12 @@ def test_generate_route_over_http(gpt):
             resp = await client.get("/stats")
             stats = await resp.json()
             assert stats["generation"]["num_slots"] == 2
+            # pipelined-decode observability: depth + host-gap/fetch EMAs +
+            # device-idle counters ride along for the continuous engine
+            pipeline = stats["generation"]["pipeline"]
+            assert pipeline["depth"] == 1 and pipeline["step_dispatches"] > 0
+            assert stats["generation"]["requests_admitted"] >= 3
+            assert stats["generation"]["tokens_decoded"] >= 5
             return single, batch
         finally:
             await client.close()

@@ -1,0 +1,308 @@
+"""Pipelined decode (depth-1 dispatch-ahead): token-identical to unpipelined.
+
+The gold properties:
+
+1. PARITY GATE — an engine with ``pipeline=True`` (dispatch step N+1 before
+   fetching step N) emits exactly the streams a ``pipeline=False`` engine emits
+   under an IDENTICAL call schedule — greedy and fixed-seed sampled, across a
+   mixed prefix-cache-hit / miss / chunked-prefill / cancel schedule, on one
+   device and on a 4-device CPU mesh (the CI stand-in for real hardware).
+2. FENCING — ``cancel``/``abort_all`` racing a dispatched-but-unfetched step:
+   survivors stay token-identical, the freed slot is re-admittable, and no
+   stale token is ever credited to a slot's next occupant.
+3. NO PER-TICK UPLOADS — a steady-state ``step()`` performs ZERO host→device
+   transfers (slot lifecycle and sampling controls ride as device mirrors),
+   pinned with ``jax.transfer_guard``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from unionml_tpu.parallel import make_mesh
+from unionml_tpu.serving.continuous import DecodeEngine
+
+BS = 4  # prefix-cache block size for the mixed schedule
+
+
+@pytest.fixture(scope="module")
+def gpt(gpt_tiny_session):
+    _, model, variables = gpt_tiny_session
+    return model, variables
+
+
+def _mesh(axes):
+    n = int(np.prod(list(axes.values())))
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices (conftest forces 8 CPU devices)")
+    return make_mesh(axes, devices=jax.devices()[:n])
+
+
+class Driver:
+    """Scripted engine driver: logs every applied token per request id.
+
+    Follows the documented pipelined-admission discipline — drain
+    ``take_pending_events`` under the OLD slot mapping before re-keying a
+    reused slot — so logs attribute flushed events to the right request.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.streams = {}  # req_id -> [tokens emitted]
+        self.req_of_slot = {}
+
+    def _pump(self, events):
+        for ev in events:
+            if ev.emit:
+                self.streams[self.req_of_slot[ev.slot]].append(ev.token)
+
+    def admit(self, req_id, prompt, budget, **sampling):
+        (slot,) = self.engine.admit_many([(prompt, budget, sampling)])
+        self._pump(self.engine.take_pending_events())
+        self.req_of_slot[slot] = req_id
+        self.streams.setdefault(req_id, [])
+        return slot
+
+    def step(self, lookahead=1):
+        self._pump(self.engine.step(lookahead))
+
+    def cancel(self, slot):
+        self.engine.cancel(slot)
+        self._pump(self.engine.take_pending_events())
+
+    def drain(self, lookahead=1):
+        eng = self.engine
+        while eng.num_active or eng.has_pending_prefill or eng.has_pending_events:
+            self.step(lookahead)
+        return self.streams
+
+
+def mixed_schedule(engine, *, sampled=False):
+    """The satellite-gate workload: prefix hit + miss + chunked prefill +
+    mid-flight cancel, driven by a FIXED tick script (no feedback from engine
+    state, so pipelined and unpipelined runs see identical call sequences).
+    Returns (streams, cancelled_req_id)."""
+    drv = Driver(engine)
+    shared = list(range(1, 11))  # 2 full blocks + a partial at BS=4
+    kw = dict(temperature=0.9, top_k=3) if sampled else {}
+    drv.admit(0, shared + [20, 21], 6, **kw)        # miss: full prefill
+    drv.step()
+    drv.step()
+    drv.admit(1, shared + [30], 5, **kw)            # prefix-cache hit
+    drv.step()
+    victim = drv.admit(2, [40, 41, 42], 12, **kw)   # unrelated miss
+    drv.step()
+    drv.admit(3, list(range(50, 64)), 4, **kw)      # 14 tokens: chunked prefill
+    drv.step()
+    drv.step()
+    drv.cancel(victim)                              # races the in-flight step
+    drv.admit(4, shared + [20, 21], 6, **kw)        # exact replay into freed slot
+    drv.drain()
+    return drv.streams, 2
+
+
+def make_engine(gpt, *, pipeline, mesh=None, seed=0, temperature=0.0):
+    model, variables = gpt
+    return DecodeEngine(
+        model, variables, num_slots=4, max_len=64,
+        prefill_buckets=(4, 8, 16), prefill_chunk=4, mesh=mesh,
+        prefix_cache_blocks=24, prefix_block_size=BS,
+        pipeline=pipeline, seed=seed, temperature=temperature,
+    )
+
+
+# ------------------------------------------------------------------ parity gate
+
+
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "sampled"])
+def test_mixed_schedule_parity_single_device(gpt, gpt_tiny_solo, sampled):
+    """Pipelined == unpipelined across hit/miss/chunked/cancel, greedy and
+    fixed-seed sampled; surviving greedy streams also == the solo reference."""
+    on, cancelled = mixed_schedule(make_engine(gpt, pipeline=True, seed=7), sampled=sampled)
+    off, _ = mixed_schedule(make_engine(gpt, pipeline=False, seed=7), sampled=sampled)
+    survivors = [r for r in on if r != cancelled]
+    assert {r: on[r] for r in survivors} == {r: off[r] for r in survivors}
+    # the cancelled request's delivered tokens may be one flush shorter
+    # pipelined (its in-flight token is dropped with its consumer), but what
+    # WAS delivered must agree
+    n = min(len(on[cancelled]), len(off[cancelled]))
+    assert on[cancelled][:n] == off[cancelled][:n]
+    if not sampled:
+        expected = {
+            0: gpt_tiny_solo(list(range(1, 11)) + [20, 21], 6),
+            1: gpt_tiny_solo(list(range(1, 11)) + [30], 5),
+            3: gpt_tiny_solo(list(range(50, 64)), 4),
+            4: gpt_tiny_solo(list(range(1, 11)) + [20, 21], 6),
+        }
+        assert {r: on[r] for r in expected} == expected
+
+
+@pytest.mark.parametrize("axes", [{"tensor": 4}], ids=["mesh4"])
+def test_mixed_schedule_parity_mesh(gpt, axes):
+    """The same gate across a 4-device CPU mesh: the sharded pipelined engine
+    matches the single-device unpipelined engine stream for stream."""
+    mesh = _mesh(axes)
+    on, cancelled = mixed_schedule(make_engine(gpt, pipeline=True, mesh=mesh))
+    off, _ = mixed_schedule(make_engine(gpt, pipeline=False))
+    survivors = [r for r in on if r != cancelled]
+    assert {r: on[r] for r in survivors} == {r: off[r] for r in survivors}
+
+
+def test_lookahead_burst_pipeline_parity(gpt):
+    """Pipelining composes with fused multi-step bursts: dispatch burst N+1
+    before fetching burst N, streams unchanged."""
+    model, variables = gpt
+    requests = [([3, 1, 4, 1, 5], 9), ([2, 7], 6), ([1, 8, 2, 8], 4)]
+
+    def run(pipeline):
+        engine = DecodeEngine(model, variables, num_slots=3, max_len=64,
+                              prefill_buckets=(8,), pipeline=pipeline)
+        drv = Driver(engine)
+        for i, (p, n) in enumerate(requests):
+            drv.admit(i, p, n)
+        return drv.drain(lookahead=4)
+
+    assert run(True) == run(False)
+
+
+def test_eos_retirement_pipelined(gpt, gpt_tiny_solo):
+    """In-program eos retirement carries across ticks: the pipelined engine
+    stops exactly where the reference does, and the trailing dispatched step
+    never resurrects the slot."""
+    model, variables = gpt
+    prompt = [3, 1, 4, 1, 5]
+    expected = gpt_tiny_solo(prompt, 6)
+    eos = expected[2]
+    engine = DecodeEngine(model, variables, num_slots=1, max_len=64,
+                          prefill_buckets=(8,), eos_token_id=eos, pipeline=True)
+    assert engine.generate(prompt, 6) == expected[: expected.index(eos)]
+    assert engine.num_active == 0
+    # the slot is immediately reusable and exact
+    assert engine.generate([9, 9, 1, 2], 5) == gpt_tiny_solo([9, 9, 1, 2], 5)
+
+
+# ---------------------------------------------------------------- race fencing
+
+
+def test_cancel_racing_dispatched_step(gpt, gpt_tiny_solo):
+    """cancel() with a dispatched-but-unfetched step in flight: the survivor's
+    stream stays token-identical, the freed slot re-admits, and the next
+    occupant's stream is exact (no stale token credited to it)."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64,
+                          prefill_buckets=(8,), pipeline=True)
+    drv = Driver(engine)
+    drv.admit(0, [3, 1, 4, 1, 5], 8)
+    victim = drv.admit(1, [2, 7], 40)
+    drv.step()
+    drv.step()
+    assert engine._inflight is not None  # a step really is dispatched-unfetched
+    drv.cancel(victim)
+    assert engine.free_slots == [victim]
+    # the freed slot serves a NEW request; both remaining streams are exact
+    slot2 = drv.admit(2, [9, 9, 1, 2], 5)
+    assert slot2 == victim
+    streams = drv.drain()
+    assert streams[0] == gpt_tiny_solo([3, 1, 4, 1, 5], 8)
+    assert streams[2] == gpt_tiny_solo([9, 9, 1, 2], 5)
+    # the cancelled stream is a prefix of its solo reference (nothing foreign)
+    ref = gpt_tiny_solo([2, 7], 40)
+    assert streams[1] == ref[: len(streams[1])]
+
+
+def test_abort_all_racing_dispatched_step(gpt, gpt_tiny_solo):
+    """abort_all() discards the in-flight step outright; the engine stays
+    usable and exact afterwards."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64,
+                          prefill_buckets=(8,), pipeline=True)
+    engine.admit_many([([3, 1, 4], 20), ([2, 7], 20)])
+    engine.step()
+    engine.step()
+    assert engine._inflight is not None
+    engine.abort_all()
+    assert engine.num_active == 0 and engine._inflight is None
+    assert not engine.has_pending_events
+    assert engine.generate([3, 1, 4], 5) == gpt_tiny_solo([3, 1, 4], 5)
+
+
+def test_cancel_mid_chunked_prefill_with_inflight_decode(gpt, gpt_tiny_solo):
+    """A chunked prefill cancelled while a neighbor's pipelined decode is in
+    flight: the neighbor is untouched and the reserved slot frees."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64,
+                          prefill_buckets=(8, 16), prefill_chunk=4, pipeline=True)
+    drv = Driver(engine)
+    drv.admit(0, [3, 1, 4, 1, 5], 8)
+    drv.step()
+    (slot,) = engine.admit_many([(list(range(1, 11)), 5)])  # reserved, chunked
+    drv.step()  # advances one chunk while a decode step is in flight
+    assert engine.has_pending_prefill
+    engine.cancel(slot)
+    assert not engine.has_pending_prefill and slot in engine.free_slots
+    streams = drv.drain()
+    assert streams[0] == gpt_tiny_solo([3, 1, 4, 1, 5], 8)
+
+
+# ------------------------------------------------------- transfer-count fence
+
+
+def test_steady_state_step_pays_zero_host_to_device_transfers(gpt):
+    """The per-tick ``active``/``remaining``/sampling uploads are gone: once the
+    step programs are compiled, ``step()`` runs entirely off device-resident
+    mirrors. ``jax.transfer_guard`` turns any regression into a hard error."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64,
+                          prefill_buckets=(8,), pipeline=True)
+    engine.admit_many([([3, 1, 4, 1, 5], 30), ([2, 7], 30)])
+    engine.step()  # compile + warm the greedy depth-1 program
+    engine.step()
+    with jax.transfer_guard_host_to_device("disallow"):
+        for _ in range(3):
+            engine.step()  # the fetch is device→host: allowed
+    # the fused-burst path shares the mirrors
+    engine.step(4)  # compile the depth-4 program outside the guard
+    with jax.transfer_guard_host_to_device("disallow"):
+        engine.step(4)
+    # and the sampling program's control vectors ride as mirrors too
+    sampled = DecodeEngine(model, variables, num_slots=1, max_len=64,
+                           prefill_buckets=(8,), temperature=0.8, pipeline=True)
+    sampled.add_request([3, 1, 4], 30, temperature=0.7, top_k=5, top_p=0.9)
+    sampled.step()
+    sampled.step()
+    with jax.transfer_guard_host_to_device("disallow"):
+        for _ in range(3):
+            sampled.step()
+
+
+def test_unpipelined_step_also_pays_zero_uploads(gpt):
+    """The hoisted mirrors are mode-independent: pipeline=False steady-state
+    ticks are equally transfer-free."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64,
+                          prefill_buckets=(8,), pipeline=False)
+    engine.admit_many([([3, 1, 4, 1, 5], 20), ([2, 7], 20)])
+    engine.step()
+    with jax.transfer_guard_host_to_device("disallow"):
+        for _ in range(3):
+            assert engine.step()
+
+
+# ------------------------------------------------------------- observability
+
+
+def test_pipeline_stats_shape_and_counters(gpt):
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64,
+                          prefill_buckets=(8,), pipeline=True)
+    engine.generate([3, 1, 4], 5)
+    stats = engine.pipeline_stats()
+    assert stats["depth"] == 1 and stats["step_dispatches"] >= 5
+    assert engine.requests_admitted == 1 and engine.tokens_decoded >= 5
+    off = DecodeEngine(model, variables, num_slots=2, max_len=64,
+                       prefill_buckets=(8,), pipeline=False)
+    off.generate([3, 1, 4], 5)
+    assert off.pipeline_stats()["depth"] == 0
+    # unpipelined dispatches find an empty device queue; pipelined ones do not
+    assert off.idle_dispatches > 0
+    assert engine.idle_dispatches < off.idle_dispatches

@@ -362,3 +362,51 @@ def test_stats_route_reports_prefix_cache(gpt):
     assert generation["prefix_cache"]["block_size"] == BS
     assert generation["prefix_cache"]["hits"] == 1
     assert generation["prefill_tokens_computed"] < 2 * 11
+
+
+# ------------------------------------------------- pipelined-step race fencing
+
+
+def _max_refcount(cache):
+    """Largest refcount anywhere in the radix tree (0 = nothing pinned)."""
+    worst, stack = 0, list(cache._root.children.values())
+    while stack:
+        node = stack.pop()
+        worst = max(worst, node.refcount)
+        stack.extend(node.children.values())
+    return worst
+
+
+def test_cancel_racing_pipelined_step_releases_refcounts(gpt, gpt_tiny_solo):
+    """cancel() racing a dispatched-but-unfetched pipelined step: the hit's
+    radix references release (no pinned-block leak), the surviving neighbor's
+    stream stays exact, and the freed slot immediately re-admits as a hit."""
+    engine = make_engine(gpt, num_slots=2)  # pipeline defaults ON
+    seed = list(range(1, 13)) + [30, 31]
+    assert engine.generate(seed, 3) == gpt_tiny_solo(seed, 3)  # seeds the tree
+    out = {"keep": [], "readmit": []}
+    (keeper,) = engine.admit_many([([70, 71, 72], 8)])
+    (victim,) = engine.admit_many([(seed[:12] + [40, 41], 20)])  # hit: holds refs
+    for _ in range(2):
+        for ev in engine.step():
+            if ev.emit and ev.slot == keeper:
+                out["keep"].append(ev.token)
+    assert engine._inflight is not None  # a decode step is dispatched-unfetched
+    assert engine._slot_path.get(victim)
+    assert _max_refcount(engine.prefix_cache) > 0
+    engine.cancel(victim)
+    assert victim not in engine._slot_path
+    # the keeper holds no blocks (3-token prompt < block size): nothing pinned
+    assert _max_refcount(engine.prefix_cache) == 0
+    # the freed slot re-admits as a hit on the still-cached prefix
+    before = engine.prefill_tokens_computed
+    (slot2,) = engine.admit_many([(seed[:12] + [50], 4)])
+    assert slot2 == victim
+    while engine.num_active or engine.has_pending_events:
+        for ev in engine.step():
+            if ev.emit:
+                out["keep" if ev.slot == keeper else "readmit"].append(ev.token)
+    assert out["keep"] == gpt_tiny_solo([70, 71, 72], 8)
+    assert out["readmit"] == gpt_tiny_solo(seed[:12] + [50], 4)
+    assert engine.prefill_tokens_computed - before == 1  # 12 of 13 restored
+    assert _max_refcount(engine.prefix_cache) == 0  # retirement released the rest

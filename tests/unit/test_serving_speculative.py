@@ -89,6 +89,11 @@ def test_speculative_batcher_serves_generate_route(pair):
             assert len(payload["tokens"]) == 6
             stats = await (await client.get("/stats")).json()
             assert stats["generation"]["num_slots"] == 1
+            # the facade surfaces the continuous engine's counter set, so the
+            # stats route reports the same shape whichever generator is in
+            assert stats["generation"]["requests_admitted"] == 1
+            assert stats["generation"]["tokens_decoded"] == 6
+            assert "pipeline" not in stats["generation"]  # no pipelined loop here
             bad = await client.post(
                 "/generate", json={"prompt_ids": [1], "max_new_tokens": 4, "top_p": 0.5}
             )

@@ -69,6 +69,9 @@ run serving 600 python bench_serving.py --bert-base --speculative --prefill-heav
 # tensor-parallel serving path (sharded DecodeEngine + batched/chunked prefill):
 # times the mesh-sharded generate + prefill-mix phases only (cheap, focused)
 run serving_mesh 420 python bench_serving.py --mesh 4
+# depth-1 pipelined decode A/B: dispatch-ahead on vs off at lookahead=1 —
+# decode tok/s + host-gap ms (the host sync this battery's tunnel magnifies)
+run serving_pipeline 300 python bench_serving.py --pipeline ab
 # most expensive phase last: ~1.3B-param decode, bf16 vs int8 weight-only
 run int8 600 python bench_int8.py
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log

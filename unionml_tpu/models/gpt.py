@@ -363,6 +363,43 @@ def init_block_pool(
     }
 
 
+def init_slot_state(num_slots: int) -> Tuple[jax.Array, jax.Array]:
+    """Zeroed device-resident slot lifecycle state for the serving engine.
+
+    ``(active, remaining)`` — a bool activity mask and an int32 token budget per
+    decode slot. The serving engine keeps these ON DEVICE and updates them
+    *inside* the compiled decode step (:func:`advance_slot_state`), so a next
+    step can be dispatched before the previous step's tokens are fetched: the
+    host never has to round-trip slot lifecycle between device steps.
+    """
+    return (
+        jnp.zeros((num_slots,), dtype=jnp.bool_),
+        jnp.zeros((num_slots,), dtype=jnp.int32),
+    )
+
+
+def advance_slot_state(
+    active: jax.Array,
+    remaining: jax.Array,
+    new_lens: jax.Array,
+    tokens: jax.Array,
+    max_len: int,
+    eos_token_id: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(jit-traceable) One decode step's slot retirement, the device-side rule.
+
+    Mirrors the host's per-token accounting exactly — budget exhausted, cache
+    room (``max_len - 1``) reached, or ``eos_token_id`` decoded — so a step
+    program carrying ``(active, remaining)`` retires slots identically to a
+    host replaying the fetched tokens. Inactive rows pass through unchanged.
+    """
+    new_remaining = jnp.where(active, remaining - 1, remaining)
+    finished = (new_remaining <= 0) | (new_lens >= max_len - 1)
+    if eos_token_id is not None:
+        finished = finished | (tokens == eos_token_id)
+    return active & ~finished, new_remaining
+
+
 def kv_block_spec(config: GPTConfig, mesh_axis_names: Tuple[str, ...]) -> Any:
     """PartitionSpec for KV block-pool leaves ``(blocks, heads, block_size,
     head_dim)``: heads on ``tensor``, exactly like :func:`kv_cache_spec`, so

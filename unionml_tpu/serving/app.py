@@ -365,11 +365,22 @@ def build_aiohttp_app(
             payload["device_latency"] = predictor.device_stats()
         gen = request.app.get("continuous_batcher")
         if gen is not None:
+            # every generator kind (continuous engine, speculative facade)
+            # surfaces the same counter set; getattr defaults keep the route
+            # total even for a custom generator exposing only the core triple
             payload["generation"] = {
                 "num_slots": gen.engine.num_slots,
                 "active": gen.engine.num_active,
                 "max_len": gen.engine.max_len,
+                "requests_admitted": getattr(gen.engine, "requests_admitted", 0),
+                "tokens_decoded": getattr(gen.engine, "tokens_decoded", 0),
             }
+            pipeline_stats = getattr(gen.engine, "pipeline_stats", None)
+            if callable(pipeline_stats):
+                # pipelined-decode observability: depth, host-gap EMA (ms the
+                # device queue sat empty before a dispatch), fetch-block EMA,
+                # and device-idle dispatch counters
+                payload["generation"]["pipeline"] = pipeline_stats()
             if getattr(gen.engine, "prefix_cache", None) is not None:
                 # hit rate + eviction churn for the KV prefix cache, plus the
                 # engine's FLOP counter the hits shrink

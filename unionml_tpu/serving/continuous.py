@@ -36,6 +36,14 @@ TPU-first shape discipline: everything the device sees is static.
   traffic (system prompts, few-shot templates, chat history) prefill FLOPs
   drop by the shared fraction while outputs stay token-identical.
 - The decode step jit-compiles exactly once per engine (all shapes fixed).
+- PIPELINED DECODE (``pipeline=True``, default): slot lifecycle (``active``,
+  ``remaining``) lives ON DEVICE and retires *inside* the compiled step, so
+  each tick dispatches step N+1 *before* blocking on step N's token fetch —
+  the host applies tokens, admits requests, and fans out events while the
+  device runs the next step, instead of the device idling behind every
+  ``device_get``. Outputs are token-identical to the unpipelined engine;
+  ``cancel``/``abort_all`` flush or discard the in-flight step so slot reuse
+  can never misattribute a stale token.
 
 Mesh-sharded serving (``mesh=``): the engine lays the model parameters out with
 the GPT family's Megatron-style ``param_shardings`` table and shards the KV
@@ -54,6 +62,7 @@ import asyncio
 import collections
 import dataclasses
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -121,6 +130,15 @@ class DecodeEngine:
         tokens' KV, so a multi-turn follow-up prompt (previous prompt +
         completion + new text) hits the whole previous turn, not just its
         prompt.
+    :param pipeline: depth-1 PIPELINED decode (default on): each :meth:`step`
+        dispatches the next device step *before* fetching the previous step's
+        tokens, so the host applies tokens / admits requests while the device
+        runs — the device never idles waiting for host scheduling. Legal
+        because slot lifecycle (``active``/``remaining``) lives on device and
+        retires *inside* the compiled step; outputs are token-identical to
+        ``pipeline=False`` (events are simply delivered one tick later).
+        ``cancel``/``abort_all``/``reset`` flush or discard the in-flight
+        step, so no stale token is ever applied to a reused slot.
     """
 
     def __init__(
@@ -141,6 +159,7 @@ class DecodeEngine:
         prefix_cache_blocks: int = 0,
         prefix_block_size: int = 16,
         prefix_cache_generated: bool = False,
+        pipeline: bool = True,
     ) -> None:
         from unionml_tpu.models.gpt import init_cache
 
@@ -225,6 +244,28 @@ class DecodeEngine:
         self.prefix_restore_dispatches = 0
         self.prefix_save_dispatches = 0
 
+        #: depth-1 pipelining: dispatch step N+1 before fetching step N's tokens
+        self.pipeline = bool(pipeline)
+        #: the dispatched-but-unfetched step: ``(tokens, masks, n_steps)`` device
+        #: arrays (leading axis = steps in the burst), or None when drained
+        self._inflight: Optional[Tuple[Any, Any, int]] = None
+        #: events replayed by an out-of-band flush (cancel/admission), delivered
+        #: by the next :meth:`step` so the batcher's fan-out sees every token
+        self._pending_events: List[StepEvent] = []
+        #: lifetime generation counters (the /stats surface both generator
+        #: kinds share — see serving.app and serving.speculative)
+        self.requests_admitted = 0
+        self.tokens_decoded = 0
+        #: device-idle accounting: a dispatch is "idle" when the device queue
+        #: was empty when it was enqueued (no in-flight step); the EMAs track
+        #: the host gap the device sat idle (ms) and the time the host spent
+        #: blocked in the token fetch (ms)
+        self.step_dispatches = 0
+        self.idle_dispatches = 0
+        self.ema_host_gap_ms: Optional[float] = None
+        self.ema_fetch_block_ms: Optional[float] = None
+        self._last_fetch_done: Optional[float] = None
+
         # prefix cache (disabled until enable_prefix_cache): host radix index +
         # device KV block pool + per-slot held node paths / token transcripts
         self.prefix_cache: Optional[Any] = None
@@ -249,8 +290,8 @@ class DecodeEngine:
             )
 
         def _decode_body(variables, cache, last_logits, lens, active, key, temp, top_k, top_p, *, sampling):
-            """One decode step — the single shared body for the single-step fns AND
-            the lookahead scans, so sampling/freeze rules cannot drift between them.
+            """One decode step — the single shared body for every step program
+            (any burst depth), so sampling/freeze rules cannot drift between them.
 
             ``sampling`` is a trace-time switch: the all-greedy program skips the
             sort/softmax sampling machinery entirely; the sampling program honors
@@ -260,7 +301,11 @@ class DecodeEngine:
 
             # dequant here (not hoisted) so weight reads stay int8 in HBM
             variables = maybe_dequant(variables)
-            key, subkey = jax.random.split(key)
+            new_key, subkey = jax.random.split(key)
+            # an all-inactive step consumes NO key: pipelining may dispatch one
+            # masked step past full retirement, and sampled streams must stay
+            # identical to an engine that (knowing the retirement) never ran it
+            new_key = jnp.where(jnp.any(active), new_key, key)
             if sampling:
                 tokens = sample_logits(last_logits, subkey, temp, top_k, top_p)
             else:
@@ -271,19 +316,60 @@ class DecodeEngine:
             # cache write lands on a column their own future prefill/decode rewrites
             new_lens = jnp.where(active, jnp.minimum(lens + 1, max_len - 1), lens)
             new_logits = jnp.where(active[:, None], logits[:, -1, :], last_logits)
-            return cache, new_logits, new_lens, tokens, key
+            return cache, new_logits, new_lens, tokens, new_key
 
-        def _make_step(sampling: bool):
-            def _fn(variables, cache, last_logits, lens, active, key, temp, top_k, top_p):
-                return _decode_body(
-                    variables, cache, last_logits, lens, active, key, temp, top_k, top_p,
-                    sampling=sampling,
+        def _make_step(n_steps: int, sampling: bool):
+            """K decode steps fused into one device program (``lax.scan``;
+            ``n_steps=1`` is the plain per-tick step).
+
+            The program CARRIES the slot lifecycle: ``active``/``remaining``
+            ride as device-resident inputs and retirement (eos / budget / cache
+            room — :func:`unionml_tpu.models.gpt.advance_slot_state`) runs
+            inside the scan, so the next step can be dispatched before this
+            one's tokens are fetched (depth-1 pipelining) and a fused burst
+            emits exactly what K sequential steps would. The host replays the
+            fetched ``(tokens, masks)`` to update its mirrors identically.
+            """
+            from unionml_tpu.models.gpt import advance_slot_state
+
+            def _multi(variables, cache, last_logits, lens, active, remaining, key, temp, top_k, top_p):
+                def body(carry, _):
+                    cache, last_logits, lens, active, remaining, key = carry
+                    cache, new_logits, new_lens, tokens, key = _decode_body(
+                        variables, cache, last_logits, lens, active, key, temp, top_k, top_p,
+                        sampling=sampling,
+                    )
+                    new_active, new_remaining = advance_slot_state(
+                        active, remaining, new_lens, tokens, max_len, eos_token_id
+                    )
+                    carry = (cache, new_logits, new_lens, new_active, new_remaining, key)
+                    return carry, (tokens, active)
+
+                carry = (cache, last_logits, lens, active, remaining, key)
+                (cache, last_logits, lens, active, remaining, key), (toks, masks) = jax.lax.scan(
+                    body, carry, None, length=n_steps
                 )
+                return cache, last_logits, lens, active, remaining, key, toks, masks
 
-            return jax.jit(_fn, donate_argnums=(1, 2))
+            return jax.jit(_multi, donate_argnums=(1, 2))
 
         self._make_step = _make_step
-        self._step_fns: Dict[bool, Any] = {}
+        self._step_fns: Dict[Tuple[int, bool], Any] = {}
+
+        def _slot_update(active, remaining, temp, top_k, top_p, slot, is_active, budget, t, k, p):
+            """Point-update the device slot mirrors for one admission/cancel —
+            ONE tiny dispatch, preserving every other slot's device-side value
+            (which may embed retirements from a still-unfetched in-flight step,
+            so a full host upload here would be WRONG, not just slow)."""
+            return (
+                active.at[slot].set(is_active),
+                remaining.at[slot].set(budget),
+                temp.at[slot].set(t),
+                top_k.at[slot].set(k),
+                top_p.at[slot].set(p),
+            )
+
+        self._slot_update_fn = jax.jit(_slot_update, donate_argnums=(0, 1, 2, 3, 4))
 
         def _prefill(variables, prompt_ids, lengths):
             """Batched bucket prefill: (rows, bucket) prompts, one device dispatch.
@@ -358,71 +444,52 @@ class DecodeEngine:
                 prefix_cache_blocks, prefix_block_size, cache_generated=prefix_cache_generated
             )
 
-        def _make_multi_step(n_steps: int, sampling: bool):
-            """K decode steps fused into one device program (``lax.scan``).
-
-            One host↔device round-trip per K tokens instead of per token: the
-            per-step token fetch is pure overhead (measured ~70ms over a remote
-            device tunnel, TPU_PROBES.log 2026-07-29; host sync + launch cost
-            device-local too). Slot retirement runs inside the scan with the same
-            rules the host applies (eos / budget / cache room), so a fused burst
-            emits exactly what K sequential :meth:`step` calls would; the host
-            replays the fetched token matrix to update its mirrors identically.
-            """
-
-            def _multi(variables, cache, last_logits, lens, active, remaining, key, temp, top_k, top_p):
-                def body(carry, _):
-                    cache, last_logits, lens, active, remaining, key = carry
-                    cache, new_logits, new_lens, tokens, key = _decode_body(
-                        variables, cache, last_logits, lens, active, key, temp, top_k, top_p,
-                        sampling=sampling,
-                    )
-                    new_remaining = jnp.where(active, remaining - 1, remaining)
-                    finished = (new_remaining <= 0) | (new_lens >= max_len - 1)
-                    if eos_token_id is not None:
-                        finished = finished | (tokens == eos_token_id)
-                    new_active = active & ~finished
-                    carry = (cache, new_logits, new_lens, new_active, new_remaining, key)
-                    return carry, (tokens, active)
-
-                carry = (cache, last_logits, lens, active, remaining, key)
-                (cache, last_logits, lens, active, remaining, key), (toks, masks) = jax.lax.scan(
-                    body, carry, None, length=n_steps
-                )
-                return cache, last_logits, lens, key, toks, masks
-
-            return jax.jit(_multi, donate_argnums=(1, 2))
-
-        self._make_multi_step = _make_multi_step
-        self._scan_fns: Dict[Tuple[int, bool], Any] = {}
-
     # ------------------------------------------------------------------ scheduling
 
     def _init_device_state(self) -> None:
         """(Re)allocate the device-side state, laid out on the mesh when sharded."""
-        from unionml_tpu.models.gpt import init_cache
+        from unionml_tpu.models.gpt import init_cache, init_slot_state
 
         cache = init_cache(self._config, self.num_slots, self.max_len)
         lens = jnp.zeros((self.num_slots,), jnp.int32)
         last_logits = jnp.zeros((self.num_slots, self._config.vocab_size), jnp.float32)
         key = jax.random.PRNGKey(self._seed + self._resets)
+        active, remaining = init_slot_state(self.num_slots)
         if self._mesh is not None:
             cache = jax.device_put(cache, self._cache_sharding)
             lens = jax.device_put(lens, self._replicated)
             last_logits = jax.device_put(last_logits, self._replicated)
             key = jax.device_put(key, self._replicated)
+            active = jax.device_put(active, self._replicated)
+            remaining = jax.device_put(remaining, self._replicated)
         self._cache, self._lens, self._last_logits, self._key = cache, lens, last_logits, key
+        self._active_dev, self._remaining_dev = active, remaining
+        # any dispatched-but-unfetched step referenced the old buffers: dead now
+        self._inflight = None
 
     def _sync_sampling_mirrors(self) -> None:
-        """Refresh the device mirrors of the per-slot sampling controls.
-
-        Called only where the host arrays mutate (:meth:`_activate`,
-        :meth:`reset`) — the decode step reuses the mirrors instead of paying a
-        host→device conversion of all three vectors every tick.
+        """Refresh the device mirrors of the per-slot sampling controls from the
+        host arrays — a FULL upload, so callable only when no step is in flight
+        (construction, :meth:`reset`, :meth:`abort_all`); per-admission changes
+        go through the point-update path in :meth:`_activate` instead.
         """
         self._temp_dev = jnp.asarray(self._slot_temp)
         self._top_k_dev = jnp.asarray(self._slot_top_k)
         self._top_p_dev = jnp.asarray(self._slot_top_p)
+
+    def _sync_slot_mirrors(self) -> None:
+        """Re-upload the device slot lifecycle (``active``/``remaining``) from
+        the host arrays. Same full-upload caveat as the sampling mirrors: the
+        host view lags a dispatched step, so callers must have flushed or
+        discarded the pipeline first."""
+        active = jnp.asarray(self._active)
+        remaining = jnp.asarray(
+            np.minimum(self._remaining, np.iinfo(np.int32).max), dtype=jnp.int32
+        )
+        if self._mesh is not None:
+            active = jax.device_put(active, self._replicated)
+            remaining = jax.device_put(remaining, self._replicated)
+        self._active_dev, self._remaining_dev = active, remaining
 
     def enable_prefix_cache(
         self, num_blocks: int, block_size: int = 16, *, cache_generated: bool = False
@@ -509,9 +576,31 @@ class DecodeEngine:
         self._slot_temp[slot] = temp
         self._slot_top_k[slot] = top_k
         self._slot_top_p[slot] = top_p
-        # the ONE place (besides reset) the sampling controls mutate: refresh
-        # their device mirrors here so step() never re-uploads them per tick
-        self._sync_sampling_mirrors()
+        self.requests_admitted += 1
+        self._slot_device_update(slot, True, budget, temp, top_k, top_p)
+
+    def _slot_device_update(
+        self, slot: int, is_active: bool, budget: int, temp: float, top_k: int, top_p: float
+    ) -> None:
+        """Mirror one slot's lifecycle + sampling controls onto the device with
+        a single point-update dispatch. Admission and cancel go through here —
+        never a full host upload, which would roll back OTHER slots' in-flight
+        device-side retirements — so step() pays zero per-tick host→device
+        transfers for any of these vectors."""
+        (
+            self._active_dev,
+            self._remaining_dev,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+        ) = self._slot_update_fn(
+            self._active_dev, self._remaining_dev,
+            self._temp_dev, self._top_k_dev, self._top_p_dev,
+            jnp.asarray(slot, jnp.int32), is_active,
+            jnp.asarray(min(int(budget), np.iinfo(np.int32).max), jnp.int32),
+            jnp.asarray(temp, jnp.float32), jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(top_p, jnp.float32),
+        )
 
     def add_request(
         self,
@@ -567,6 +656,13 @@ class DecodeEngine:
             sampling = dict(req[2]) if len(req) > 2 and req[2] else {}
             normalized.append(self.validate_request(prompt_ids, budget, **sampling))
         free = self.free_slots
+        if len(normalized) > len(free) and self._inflight is not None:
+            # the in-flight pipelined step may hold retirements the host has not
+            # replayed yet: fetch it before refusing, so admission is exactly as
+            # responsive as an unpipelined engine (the events reach the caller
+            # through the next step())
+            self._pending_events.extend(self._fetch_inflight())
+            free = self.free_slots
         if len(normalized) > len(free):
             raise RuntimeError("no free decode slots")
         slots = [free[i] for i in range(len(normalized))]
@@ -844,6 +940,9 @@ class DecodeEngine:
         # the key is also a step output, so it is poisoned too; a fresh
         # reset-counted key keeps sampled streams from repeating the pre-crash run
         self._resets += 1
+        # a dispatched-but-unfetched step is poisoned with the rest of the
+        # device state: DISCARD it (never fetch), and drop its replayed events
+        self._pending_events.clear()
         self._init_device_state()
         self._active[:] = False
         self._reserved[:] = False
@@ -869,7 +968,10 @@ class DecodeEngine:
                 self._pool = jax.device_put(self._pool, self._cache_sharding)
 
     def _apply_token(self, slot: int, token: int) -> StepEvent:
-        """Advance the host mirrors for one decoded token (same rules as on device)."""
+        """Advance the host mirrors for one decoded token (same rules as the
+        device applies in-program — :func:`~unionml_tpu.models.gpt.advance_slot_state` —
+        so host and device views re-converge at every fetch)."""
+        self.tokens_decoded += 1
         self._remaining[slot] -= 1
         self._lens_host[slot] = min(self._lens_host[slot] + 1, self.max_len - 1)
         tokens = self._slot_tokens.get(slot)
@@ -889,6 +991,82 @@ class DecodeEngine:
                 self._release_prefix(slot)
         return StepEvent(slot=slot, token=token, emit=not is_eos, finished=finished)
 
+    @property
+    def has_pending_events(self) -> bool:
+        """Events replayed by an out-of-band pipeline flush (cancel/admission),
+        awaiting delivery through the next :meth:`step` — drive loops must keep
+        ticking while any are queued."""
+        return bool(self._pending_events)
+
+    def take_pending_events(self) -> List[StepEvent]:
+        """Drain the events buffered by an out-of-band pipeline flush.
+
+        Callers that keep their own slot→request mapping MUST drain these
+        right after :meth:`admit_many` and attribute them under the mapping
+        that existed BEFORE the call: a flush inside admission can retire a
+        slot's previous occupant, and the buffered events belong to it — not
+        to whichever request the freed slot was just handed to. (The
+        :class:`ContinuousBatcher` does exactly this before re-keying its
+        sinks.) Events left undrained are delivered by the next :meth:`step`.
+        """
+        events, self._pending_events = self._pending_events, []
+        return events
+
+    def pipeline_stats(self) -> Dict[str, Any]:
+        """Pipeline observability for ``GET /stats``: configured depth, whether a
+        step is currently in flight, dispatch/idle counters, and the host-gap /
+        fetch-block EMAs (ms)."""
+        return {
+            "depth": 1 if self.pipeline else 0,
+            "inflight": self._inflight is not None,
+            "step_dispatches": self.step_dispatches,
+            "idle_dispatches": self.idle_dispatches,
+            "ema_host_gap_ms": None
+            if self.ema_host_gap_ms is None
+            else round(self.ema_host_gap_ms, 3),
+            "ema_fetch_block_ms": None
+            if self.ema_fetch_block_ms is None
+            else round(self.ema_fetch_block_ms, 3),
+        }
+
+    def _fetch_inflight(self) -> List[StepEvent]:
+        """Fetch the dispatched-but-unfetched step (no-op when none) and replay
+        its tokens into the host mirrors under the slot mapping the step was
+        dispatched with."""
+        if self._inflight is None:
+            return []
+        burst, self._inflight = self._inflight, None
+        return self._replay_burst(burst)
+
+    def _replay_burst(self, burst: Tuple[Any, Any, int]) -> List[StepEvent]:
+        """Block on one dispatched burst's ``(tokens, masks)`` and apply them.
+
+        ONE fused ``device_get`` for tokens and masks; a device failure
+        surfacing here poisons the donated buffers, so it resets the engine
+        exactly like a dispatch failure."""
+        tokens, masks, _ = burst
+        t0 = time.perf_counter()
+        try:
+            tokens_host, masks_host = map(np.asarray, jax.device_get((tokens, masks)))
+        except Exception:
+            self.reset()
+            raise
+        done = time.perf_counter()
+        block_ms = (done - t0) * 1e3
+        self.ema_fetch_block_ms = (
+            block_ms
+            if self.ema_fetch_block_ms is None
+            else 0.8 * self.ema_fetch_block_ms + 0.2 * block_ms
+        )
+        self._last_fetch_done = done
+        events: List[StepEvent] = []
+        for i in range(tokens_host.shape[0]):
+            events.extend(
+                self._apply_token(int(slot), int(tokens_host[i, int(slot)]))
+                for slot in np.flatnonzero(masks_host[i])
+            )
+        return events
+
     def step(self, lookahead: int = 1) -> List[StepEvent]:
         """Decode for every active slot; returns per-slot events.
 
@@ -900,9 +1078,24 @@ class DecodeEngine:
             Clamped to the largest useful depth for the current slots; compiled
             once per distinct depth.
 
+        With ``pipeline=True`` (the default) each call DISPATCHES the next
+        step/burst *before* fetching the previous one's tokens: the device runs
+        step N+1 while the host applies step N's tokens, admits requests, and
+        fans out events — so events arrive one call later than the dispatch
+        that produced them, and the device never idles on host scheduling.
+        Retirement runs inside the compiled step either way, so pipelined and
+        unpipelined engines emit identical streams (greedy and fixed-seed
+        sampled) under identical call schedules.
+
         A device failure mid-step resets the engine (see :meth:`reset`) and
         re-raises; every in-flight request is lost but the engine stays usable.
         """
+        events: List[StepEvent] = []
+        if self._pending_events:
+            # replayed by an out-of-band flush (cancel / contended admission):
+            # deliver them FIRST — they predate anything this tick produces
+            events.extend(self._pending_events)
+            self._pending_events.clear()
         if self._partials:
             # chunked prefills advance one chunk per tick, between decode
             # dispatches, so long prompts never stall the in-flight batch
@@ -912,94 +1105,130 @@ class DecodeEngine:
                 self.reset()
                 raise
         if not self._active.any():
-            return []
+            return events
         lookahead = max(1, int(lookahead))
+        # host-side accounting of the dispatched-but-unfetched burst: the host
+        # mirrors lag it, so depth planning subtracts its steps
+        inflight_steps = self._inflight[2] if self._inflight is not None else 0
+        room = np.minimum(
+            self._remaining[self._active],
+            (self.max_len - 1) - self._lens_host[self._active],
+        )
+        # every active slot runs at least one more step (a slot admitted at the
+        # cache-room boundary decodes once and force-finishes), hence the floor
+        headroom = max(1, int(room.max())) - inflight_steps
+        if headroom <= 0:
+            # budget/cache-room retirement is deterministic: every slot the host
+            # still thinks active retires within the in-flight burst. Fetch it
+            # instead of dispatching a guaranteed-masked step.
+            events.extend(self._fetch_inflight())
+            return events
         if lookahead > 1:
             # no point scanning past the moment the last slot can retire — but a
             # clamp to the EXACT depth would compile a distinct scan program per
             # tail length, so round up to the next power of two: a bounded ladder
             # of programs (log2 K of them), at most `needed` wasted masked steps
-            room = np.minimum(
-                self._remaining[self._active],
-                (self.max_len - 1) - self._lens_host[self._active],
-            )
-            needed = max(1, int(room.max()))
-            if needed < lookahead:
-                lookahead = min(lookahead, 1 << (needed - 1).bit_length())
+            if headroom < lookahead:
+                lookahead = min(lookahead, 1 << (headroom - 1).bit_length())
         # the all-greedy program skips the sampling machinery; heterogeneous slots
-        # share the sampling program with per-row controls. The control vectors
-        # ride as device mirrors refreshed only when _activate/reset mutate them
-        # — not re-uploaded per tick; activity changes every step, so it uploads.
+        # share the sampling program with per-row controls. Everything the step
+        # consumes — activity, budgets, sampling controls — rides as
+        # device-resident mirrors (refreshed in _activate/cancel/reset), so a
+        # steady-state tick performs ZERO host→device transfers (pinned by the
+        # transfer-guard regression test).
         sampling = bool((self._slot_temp[self._active] > 0).any())
-        active_dev = jnp.asarray(self._active)
-        temp_dev = self._temp_dev
-        top_k_dev = self._top_k_dev
-        top_p_dev = self._top_p_dev
-        if lookahead == 1:
-            fn = self._step_fns.get(sampling)
-            if fn is None:
-                fn = self._step_fns[sampling] = self._make_step(sampling)
-            try:
-                self._cache, self._last_logits, self._lens, tokens, self._key = fn(
-                    self._variables, self._cache, self._last_logits, self._lens,
-                    active_dev, self._key, temp_dev, top_k_dev, top_p_dev,
-                )
-                tokens_host = np.asarray(jax.device_get(tokens))  # hard sync (see utils.hard_sync)
-            except Exception:
-                self.reset()
-                raise
-            return [
-                self._apply_token(int(slot), int(tokens_host[int(slot)]))
-                for slot in np.flatnonzero(self._active)
-            ]
-
-        fn = self._scan_fns.get((lookahead, sampling))
+        fn = self._step_fns.get((lookahead, sampling))
         if fn is None:
-            fn = self._scan_fns[(lookahead, sampling)] = self._make_multi_step(lookahead, sampling)
-        remaining_dev = jnp.asarray(
-            np.minimum(self._remaining, np.iinfo(np.int32).max), dtype=jnp.int32
-        )
+            fn = self._step_fns[(lookahead, sampling)] = self._make_step(lookahead, sampling)
+        t0 = time.perf_counter()
+        device_was_idle = self._inflight is None
         try:
             (
                 self._cache,
                 self._last_logits,
                 self._lens,
+                self._active_dev,
+                self._remaining_dev,
                 self._key,
                 tokens,
                 masks,
             ) = fn(
                 self._variables, self._cache, self._last_logits, self._lens,
-                active_dev, remaining_dev, self._key, temp_dev, top_k_dev, top_p_dev,
+                self._active_dev, self._remaining_dev, self._key,
+                self._temp_dev, self._top_k_dev, self._top_p_dev,
             )
-            # ONE hard sync for the whole burst: fetching tokens and masks
-            # separately would pay the host round-trip twice per scan
-            tokens_host, masks_host = map(np.asarray, jax.device_get((tokens, masks)))
         except Exception:
             self.reset()
             raise
-        events: List[StepEvent] = []
-        for i in range(tokens_host.shape[0]):
-            events.extend(
-                self._apply_token(int(slot), int(tokens_host[i, int(slot)]))
-                for slot in np.flatnonzero(masks_host[i])
+        self.step_dispatches += 1
+        if device_was_idle and self._last_fetch_done is not None:
+            self.idle_dispatches += 1
+        if self._last_fetch_done is not None:
+            # host gap = how long the device queue sat EMPTY before this
+            # dispatch (0 when a step was still in flight — the pipelined case).
+            # Clamped so a genuine idle wait for traffic cannot poison the EMA.
+            gap_ms = (
+                min((t0 - self._last_fetch_done) * 1e3, 250.0) if device_was_idle else 0.0
             )
+            self.ema_host_gap_ms = (
+                gap_ms
+                if self.ema_host_gap_ms is None
+                else 0.8 * self.ema_host_gap_ms + 0.2 * gap_ms
+            )
+        previous, self._inflight = self._inflight, (tokens, masks, lookahead)
+        if previous is not None:
+            # dispatch-ahead: the new step is already queued on the device
+            # while the host blocks on (and then applies) the previous one
+            events.extend(self._replay_burst(previous))
+        if not self.pipeline:
+            events.extend(self._fetch_inflight())  # hard sync (see utils.hard_sync)
         return events
 
     def abort_all(self) -> None:
-        """Deactivate every slot (in-flight state is abandoned; cache reuse is safe)."""
+        """Deactivate every slot (in-flight state is abandoned; cache reuse is safe).
+
+        A dispatched-but-unfetched pipelined step is DISCARDED, not flushed:
+        every request it could emit for is being abandoned, so fetching it
+        would only manufacture events with no consumer. The device slot
+        mirrors re-upload from the (now all-inactive) host arrays — legal
+        precisely because the pipeline is empty.
+        """
+        self._inflight = None
+        self._pending_events.clear()
         self._active[:] = False
         self._reserved[:] = False
         self._partials.clear()
         for slot in list(self._slot_path):
             self._release_prefix(slot)
         self._slot_tokens.clear()
+        self._remaining[:] = 0
+        self._sync_slot_mirrors()
 
     def cancel(self, slot: int) -> None:
-        """Deactivate one slot (its request is abandoned; the slot is reusable)."""
+        """Deactivate one slot (its request is abandoned; the slot is reusable).
+
+        With a pipelined step in flight the engine FLUSHES it first: the step
+        was dispatched while this slot (and its neighbors) were still live, so
+        its tokens must be applied under the OLD slot mapping — deferring the
+        fetch past a readmission would credit the stale token to the slot's
+        next occupant. Survivors' flushed events are delivered by the next
+        :meth:`step`; the cancelled slot's device mirror is then point-updated
+        to inactive so the device stops decoding it.
+        """
+        self._pending_events.extend(self._fetch_inflight())
+        # the flush may have buffered this slot's own tokens: its consumer is
+        # gone, and delivering them later could credit them to the slot's NEXT
+        # occupant — drop them (survivors' events stay queued)
+        self._pending_events = [ev for ev in self._pending_events if ev.slot != slot]
         self._active[slot] = False
         self._reserved[slot] = False
+        self._remaining[slot] = 0
+        self._slot_temp[slot] = self.temperature
+        self._slot_top_k[slot] = 0
+        self._slot_top_p[slot] = 1.0
         self._partials.pop(slot, None)
         self._release_prefix(slot)
+        self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
 
     def generate(
         self,
@@ -1188,6 +1417,7 @@ class ContinuousBatcher:
                 admissible.append((prompt, budget, sampling, sink))
             if not admissible:
                 continue
+            resets_before = getattr(self._engine, "_resets", 0)
             try:
                 # one admission call: same-bucket prompts share batched prefill
                 # dispatches (⌈N/prefill_batch⌉ per bucket, not N)
@@ -1197,9 +1427,56 @@ class ContinuousBatcher:
             except Exception as exc:  # device-side failure: fail this batch, keep serving
                 for *_, sink in admissible:
                     self._deliver(sink, "fail", exc)
+                if getattr(self._engine, "_resets", 0) != resets_before:
+                    # the failure reset the engine (a pipeline flush inside
+                    # admission can surface a deferred device error): every
+                    # in-flight request died with the device state — fail their
+                    # sinks too instead of letting their futures hang forever
+                    for sink in self._sinks.values():
+                        self._deliver(sink, "fail", RuntimeError(str(exc)))
+                    self._sinks.clear()
                 continue
+            if getattr(self._engine, "has_pending_events", False):
+                # admission flushed the pipeline and may have retired previous
+                # occupants of the slots just handed out: deliver their events
+                # to the OLD sinks before the new sinks take over the mapping
+                self._dispatch_events(self._engine.take_pending_events())
             for slot, (*_, sink) in zip(slots, admissible):
                 self._sinks[slot] = sink
+
+    def _fail_all(self, exc: Exception) -> None:
+        """Fail every in-flight request and abandon the engine's slots."""
+        for sink in self._sinks.values():
+            self._deliver(sink, "fail", RuntimeError(str(exc)))
+        self._sinks.clear()
+        self._engine.abort_all()
+
+    def _dispatch_events(self, events) -> None:
+        """Fan one step's events out to their sinks (cancel on dead consumers)."""
+        for event in events:
+            sink = self._sinks.get(event.slot)
+            if sink is None:
+                continue
+            if sink.cancelled:  # consumer abandoned the stream mid-decode
+                del self._sinks[event.slot]
+                # a FINISHED event's slot already retired engine-side — and may
+                # even hold a newly admitted request by the time a pipeline-
+                # flushed event is delivered, so cancelling it would kill the
+                # wrong occupant. Only a still-running slot needs the cancel.
+                if not event.finished:
+                    self._engine.cancel(event.slot)
+                continue
+            ok = True
+            if event.emit:
+                ok = self._deliver(sink, "emit", event.token)
+            if not ok:
+                del self._sinks[event.slot]
+                if not event.finished:
+                    self._engine.cancel(event.slot)
+                continue
+            if event.finished:
+                del self._sinks[event.slot]
+                self._deliver(sink, "finish")
 
     def _run(self) -> None:
         while True:
@@ -1207,16 +1484,20 @@ class ContinuousBatcher:
                 if self._closed and not self._pending and not self._sinks:
                     return
             self._admit()
-            if self._engine.num_active == 0 and self._engine.has_pending_prefill:
-                # chunked prefills need ticks even with nothing decoding
+            if self._engine.num_active == 0 and (
+                self._engine.has_pending_prefill
+                or getattr(self._engine, "has_pending_events", False)
+            ):
+                # chunked prefills need ticks even with nothing decoding, and a
+                # pipeline flush (cancel path) may have buffered events whose
+                # sinks are still waiting
                 try:
-                    self._engine.step()
+                    events = self._engine.step()
                 except Exception as exc:
                     logger.exception("chunked-prefill tick failed")
-                    for sink in self._sinks.values():
-                        self._deliver(sink, "fail", RuntimeError(str(exc)))
-                    self._sinks.clear()
-                    self._engine.abort_all()
+                    self._fail_all(exc)
+                    continue
+                self._dispatch_events(events)
                 continue
             if self._engine.num_active == 0:
                 self._work.clear()
@@ -1237,29 +1518,9 @@ class ContinuousBatcher:
                 )
             except Exception as exc:  # fail every in-flight request loudly
                 logger.exception("continuous-batching step failed")
-                for sink in self._sinks.values():
-                    self._deliver(sink, "fail", RuntimeError(str(exc)))
-                self._sinks.clear()
-                self._engine.abort_all()
+                self._fail_all(exc)
                 continue
-            for event in events:
-                sink = self._sinks.get(event.slot)
-                if sink is None:
-                    continue
-                if sink.cancelled:  # consumer abandoned the stream mid-decode
-                    del self._sinks[event.slot]
-                    self._engine.cancel(event.slot)
-                    continue
-                ok = True
-                if event.emit:
-                    ok = self._deliver(sink, "emit", event.token)
-                if not ok:
-                    del self._sinks[event.slot]
-                    self._engine.cancel(event.slot)
-                    continue
-                if event.finished:
-                    del self._sinks[event.slot]
-                    self._deliver(sink, "finish")
+            self._dispatch_events(events)
 
     def close(self) -> None:
         with self._lock:

@@ -57,9 +57,18 @@ class SpeculativeBatcher:
         self._key = jax.random.PRNGKey(0)
         # the /stats view; num_slots=1 states the single-stream design honestly.
         # bucket_for is the route's prefill-validation hook: speculation prefills
-        # at the exact prompt length (no bucket ladder), so identity is correct
+        # at the exact prompt length (no bucket ladder), so identity is correct.
+        # requests_admitted / tokens_decoded / prefill_tokens_computed mirror the
+        # continuous engine's generation counters, so the stats route reports the
+        # same shape whichever generator is plugged in
         self.engine = SimpleNamespace(
-            num_slots=1, num_active=0, max_len=self._max_len, bucket_for=lambda n: n
+            num_slots=1,
+            num_active=0,
+            max_len=self._max_len,
+            bucket_for=lambda n: n,
+            requests_admitted=0,
+            tokens_decoded=0,
+            prefill_tokens_computed=0,
         )
 
     # ------------------------------------------------------------------ request path
@@ -92,6 +101,7 @@ class SpeculativeBatcher:
             else:
                 self._key, rng = jax.random.split(self._key)
             self.engine.num_active = 1
+            self.engine.requests_admitted += 1
             try:
                 out = speculative_generate(
                     self._target,
@@ -106,7 +116,10 @@ class SpeculativeBatcher:
                 )
             finally:
                 self.engine.num_active = 0
-        return [int(t) for t in np.asarray(out)[0, prompt.size :]]
+        tokens = [int(t) for t in np.asarray(out)[0, prompt.size :]]
+        self.engine.prefill_tokens_computed += int(prompt.size)
+        self.engine.tokens_decoded += len(tokens)
+        return tokens
 
     async def generate(
         self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling
