@@ -41,6 +41,46 @@ def _measure(fn, iters=200):
     }
 
 
+class _RetraceCounter:
+    """Counts jaxpr traces (jit cache misses) across a timed window.
+
+    Hooks ``jax.monitoring``'s duration events: every compile records a
+    ``/jax/core/compile/jaxpr_trace_duration`` event, so the count across a
+    bench window is exactly the number of retraces the workload paid — the
+    measured number graftlint's ``retrace`` rule findings correlate with
+    (ISSUE 4 satellite). A steady-state window after warmup should report 0;
+    admission windows report the (bounded) bucket-ladder compiles.
+    """
+
+    EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+    def __init__(self) -> None:
+        self.count = 0
+
+    def _listener(self, name, *args, **kwargs):
+        if name == self.EVENT:
+            self.count += 1
+
+    def __enter__(self) -> "_RetraceCounter":
+        try:
+            from jax._src import monitoring
+        except ImportError:  # jax moved the module: report None, never crash a bench
+            self._monitoring = None
+            self.count = None
+            return self
+        self._monitoring = monitoring
+        monitoring.register_event_duration_secs_listener(self._listener)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._monitoring is None:
+            return
+        try:
+            self._monitoring._unregister_event_duration_listener_by_callback(self._listener)
+        except Exception:  # listener API drift: a leaked counter only overcounts
+            pass
+
+
 def _build_mlp_model(name: str):
     """The shared 64-feature MLP app both MLP benches measure (keep them comparable)."""
     import jax
@@ -423,16 +463,18 @@ def bench_prefill_mix(n_prompts: int = 16, prompt_len: int = 48, max_new_tokens:
         while engine.num_active:
             engine.step()
         warm_dispatches = engine.prefill_dispatches
-        t0 = time.perf_counter()
-        slots = engine.admit_many(requests)
-        admit_s = time.perf_counter() - t0
-        while engine.num_active:
-            engine.step()
-        total_s = time.perf_counter() - t0
+        with _RetraceCounter() as retraces:
+            t0 = time.perf_counter()
+            slots = engine.admit_many(requests)
+            admit_s = time.perf_counter() - t0
+            while engine.num_active:
+                engine.step()
+            total_s = time.perf_counter() - t0
         return {
             "admit_s": round(admit_s, 4),
             "total_s": round(total_s, 4),
             "prefill_dispatches": engine.prefill_dispatches - warm_dispatches,
+            "retraces": retraces.count,
             "prompts_per_s_admission": round(len(slots) / admit_s, 1),
         }
 
@@ -591,19 +633,24 @@ def bench_pipeline(modes=("on", "off"), n_requests: int = 8, max_new_tokens: int
         engine.step_dispatches = engine.idle_dispatches = 0
         base_tokens = engine.tokens_decoded
         pending = list(prompts)
-        t0 = time.perf_counter()
-        while pending or engine.num_active or engine.has_pending_events:
-            free = len(engine.free_slots)
-            if pending and free:
-                wave, pending = pending[:free], pending[free:]
-                engine.admit_many([(p, max_new_tokens) for p in wave])
-            engine.step()
-        elapsed = time.perf_counter() - t0
+        # retrace counter over the TIMED window: correlates graftlint retrace
+        # findings with a measured number — a clean steady state reports the
+        # (bounded) admission-shape compiles and nothing per-step
+        with _RetraceCounter() as retraces:
+            t0 = time.perf_counter()
+            while pending or engine.num_active or engine.has_pending_events:
+                free = len(engine.free_slots)
+                if pending and free:
+                    wave, pending = pending[:free], pending[free:]
+                    engine.admit_many([(p, max_new_tokens) for p in wave])
+                engine.step()
+            elapsed = time.perf_counter() - t0
         decoded = engine.tokens_decoded - base_tokens
         return {
             "decode_tok_s": round(decoded / elapsed, 1),
             "total_s": round(elapsed, 4),
             "tokens": decoded,
+            "retraces": retraces.count,
             "ema_host_gap_ms": round(engine.ema_host_gap_ms or 0.0, 3),
             "ema_fetch_block_ms": round(engine.ema_fetch_block_ms or 0.0, 3),
             "idle_dispatch_frac": round(

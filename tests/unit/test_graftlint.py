@@ -1,0 +1,268 @@
+"""graftlint fixture corpus: one minimal repro per rule, suppression behavior,
+the JSON report schema, and CLI exit codes.
+
+These pins are the linter's own regression suite — the companion
+``test_lint_clean.py`` is the CI gate that holds the *shipped tree* finding-free.
+Fixtures are written to ``tmp_path`` so each repro is a real file run through
+the full pipeline (tokenize comments + ast + call graph), not a unit poke at a
+rule function.
+"""
+
+import json
+
+import pytest
+
+from unionml_tpu.analysis import REPORT_VERSION, run_lint
+from unionml_tpu.analysis.__main__ import main as lint_main
+
+# --------------------------------------------------------------------- corpus
+
+HOST_SYNC_REPRO = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def traced(x):
+    return np.asarray(x) + x.sum().item()
+
+def fetch_helper(x):
+    return x.block_until_ready()
+
+def steady(x):  # graftlint: hot-path
+    return fetch_helper(jax.device_get(x))
+'''
+
+RETRACE_REPRO = '''
+import jax
+
+def f(x, k):
+    return x * k
+
+g = jax.jit(f, static_argnums=(1,))
+
+def sites(x):
+    return g(x, 2), g(x, 3), g([1, 2], 4)
+
+def churn(xs):
+    for x in xs:
+        h = jax.jit(lambda v: v + 1)
+    return h
+'''
+
+SHARDING_REPRO = '''
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+def make(devs):
+    return Mesh(np.asarray(devs), ("data", "tensor"))
+
+def layout(mesh, stray):
+    return NamedSharding(mesh, P("tensr")), NamedSharding(stray, P("data"))
+'''
+
+LOCKS_REPRO = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []  # guarded-by: _lock
+        # guarded-by: _lock
+        self.stats = object()
+
+    def enqueue(self, item):
+        self._queue.append(item)          # BAD: no lock held
+
+    def bump(self, n):
+        self.stats.count = n              # BAD: nested write, no lock held
+        with self._lock:
+            self._queue.append(n)         # ok
+'''
+
+SUPPRESSED = '''
+import jax
+
+@jax.jit
+def traced(x):
+    # graftlint: disable=host-sync -- fixture: documents a known-safe concretization
+    return x.sum().item()
+'''
+
+CLEAN = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return jnp.where(x > 0, x, -x)
+
+def drive(x):  # graftlint: hot-path
+    return step(x)
+'''
+
+
+def _lint_source(tmp_path, name, source, rules=None):
+    f = tmp_path / f"{name}.py"
+    f.write_text(source)
+    return run_lint([str(f)], rules)
+
+
+# ------------------------------------------------------------- per-rule repros
+
+
+def test_host_sync_repro_fires_and_reaches_through_the_call_graph(tmp_path):
+    result = _lint_source(tmp_path, "hs", HOST_SYNC_REPRO)
+    rules = {f.rule for f in result.findings}
+    assert rules == {"host-sync"}
+    messages = "\n".join(f.message for f in result.findings)
+    assert "np.asarray" in messages and ".item()" in messages
+    # call-graph, not syntax: the hazard inside fetch_helper is attributed
+    # because the hot-path root `steady` calls it
+    assert any(f.symbol == "fetch_helper" for f in result.findings)
+    assert any(f.symbol == "steady" for f in result.findings)
+
+
+def test_retrace_repro_fires(tmp_path):
+    result = _lint_source(tmp_path, "rt", RETRACE_REPRO)
+    assert {f.rule for f in result.findings} == {"retrace"}
+    messages = "\n".join(f.message for f in result.findings)
+    assert "distinct literal values" in messages        # static ladder variance
+    assert "container literal" in messages              # [1, 2] in traced position
+    assert "inside a loop" in messages                  # jit-in-loop
+
+
+def test_sharding_repro_fires(tmp_path):
+    result = _lint_source(tmp_path, "sh", SHARDING_REPRO)
+    assert {f.rule for f in result.findings} == {"sharding"}
+    messages = "\n".join(f.message for f in result.findings)
+    assert "'tensr'" in messages                        # unknown axis
+    assert "'stray'" in messages                        # foreign mesh variable
+
+
+def test_lock_discipline_repro_fires(tmp_path):
+    result = _lint_source(tmp_path, "lk", LOCKS_REPRO)
+    assert {f.rule for f in result.findings} == {"lock-discipline"}
+    assert len(result.findings) == 2  # append outside lock + nested stats write
+    lines = {f.line for f in result.findings}
+    symbols = {f.symbol for f in result.findings}
+    assert symbols == {"Worker.enqueue", "Worker.bump"}
+    # the locked append is NOT flagged
+    assert max(lines) < LOCKS_REPRO.count("\n")
+
+
+def test_clean_fixture_is_finding_free(tmp_path):
+    result = _lint_source(tmp_path, "ok", CLEAN)
+    assert result.ok, [f.format() for f in result.findings]
+    assert not result.suppressed
+
+
+# -------------------------------------------------------------- suppressions
+
+
+def test_suppression_silences_with_reason_and_is_reported(tmp_path):
+    result = _lint_source(tmp_path, "sup", SUPPRESSED)
+    assert result.ok, [f.format() for f in result.findings]
+    assert len(result.suppressed) == 1
+    sup = result.suppressed[0]
+    assert sup.rule == "host-sync" and sup.suppressed
+    assert sup.reason == "fixture: documents a known-safe concretization"
+
+
+def test_suppression_without_reason_is_itself_a_finding(tmp_path):
+    source = SUPPRESSED.replace(" -- fixture: documents a known-safe concretization", "")
+    result = _lint_source(tmp_path, "noreason", source)
+    rules = {f.rule for f in result.findings}
+    # the hazard is NOT silenced and the naked suppression is flagged
+    assert rules == {"host-sync", "suppression"}
+    assert any("requires a reason" in f.message for f in result.findings)
+
+
+def test_suppression_of_unknown_rule_is_flagged(tmp_path):
+    source = SUPPRESSED.replace("disable=host-sync", "disable=not-a-rule")
+    result = _lint_source(tmp_path, "unknown", source)
+    assert any(
+        f.rule == "suppression" and "unknown rule" in f.message for f in result.findings
+    )
+    assert any(f.rule == "host-sync" for f in result.findings)  # not silenced
+
+
+def test_inline_suppression_applies_to_its_own_line(tmp_path):
+    source = (
+        "import jax\n\n@jax.jit\ndef traced(x):\n"
+        "    return x.sum().item()  # graftlint: disable=host-sync -- fixture inline\n"
+    )
+    result = _lint_source(tmp_path, "inline", source)
+    assert result.ok and len(result.suppressed) == 1
+
+
+# ----------------------------------------------------------------- the report
+
+
+def test_json_report_schema(tmp_path):
+    result = _lint_source(tmp_path, "schema", HOST_SYNC_REPRO)
+    report = json.loads(result.report_json())
+    assert report["graftlint"] == REPORT_VERSION
+    assert set(report) == {
+        "graftlint", "paths", "rules", "files", "counts", "findings", "suppressed",
+    }
+    assert report["files"] == 1
+    assert report["counts"] == {
+        "findings": len(result.findings),
+        "suppressed": len(result.suppressed),
+    }
+    for entry in report["findings"]:
+        assert set(entry) == {"rule", "path", "line", "col", "message", "symbol"}
+        assert isinstance(entry["line"], int) and entry["line"] > 0
+    # suppressed entries carry the reason
+    sup = _lint_source(tmp_path, "schema_sup", SUPPRESSED).report()
+    assert sup["suppressed"][0]["reason"]
+
+
+def test_rule_subset_selection(tmp_path):
+    result = _lint_source(tmp_path, "subset", HOST_SYNC_REPRO, rules=["sharding"])
+    assert result.ok  # the host-sync hazards are out of scope for this run
+    with pytest.raises(ValueError, match="unknown rule"):
+        _lint_source(tmp_path, "subset2", CLEAN, rules=["nope"])
+
+
+def test_syntax_error_is_a_parse_finding_not_a_crash(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def oops(:\n")
+    result = run_lint([str(f)])
+    assert any(fi.rule == "parse" for fi in result.findings)
+
+
+# ------------------------------------------------------------------------ CLI
+
+
+def test_cli_exits_nonzero_on_each_rule_repro_and_zero_on_clean(tmp_path, capsys):
+    """The acceptance contract: non-zero on every per-rule repro, zero clean."""
+    repros = {
+        "host-sync": HOST_SYNC_REPRO,
+        "retrace": RETRACE_REPRO,
+        "sharding": SHARDING_REPRO,
+        "lock-discipline": LOCKS_REPRO,
+    }
+    for rule, source in repros.items():
+        bad = tmp_path / f"{rule.replace('-', '_')}_repro.py"
+        bad.write_text(source)
+        assert lint_main([str(bad)]) == 1, f"{rule} repro did not fail the CLI"
+    ok = tmp_path / "ok.py"
+    ok.write_text(CLEAN)
+    assert lint_main([str(ok)]) == 0
+    bad = tmp_path / "host_sync_repro.py"
+    assert lint_main([str(bad), "--no-fail-on-findings"]) == 0
+    assert lint_main([str(bad), "--rules", "nope"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_writes_json_report(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(RETRACE_REPRO)
+    out = tmp_path / "report.json"
+    assert lint_main([str(bad), "--json", str(out)]) == 1
+    report = json.loads(out.read_text())
+    assert report["graftlint"] == REPORT_VERSION
+    assert report["counts"]["findings"] > 0
+    capsys.readouterr()

@@ -288,6 +288,92 @@ def test_unpipelined_step_also_pays_zero_uploads(gpt):
             assert engine.step()
 
 
+def test_prefix_hit_admission_pays_only_explicit_transfers(gpt):
+    """ISSUE-4 satellite: the prefix-cache admit path under the transfer guard.
+
+    A full-block-hit ``admit_many`` runs with implicit host→device transfers
+    DISALLOWED: every upload on the hit path (restore block ids, suffix ids,
+    chunk position, insert indices, the slot point-update scalars) must be an
+    explicit ``device_put``. The steady-state steps that follow stay
+    transfer-free as before — so an upload regression anywhere on the hot
+    admission entry point fails here at runtime, mirroring what graftlint's
+    host-sync rule pins statically."""
+    model, variables = gpt
+    engine = DecodeEngine(model, variables, num_slots=2, max_len=64,
+                          prefill_buckets=(8, 16), pipeline=True,
+                          prefix_cache_blocks=8, prefix_block_size=4)
+    prompt = [5, 6, 7, 8, 1, 2, 3, 4, 9]  # two full blocks + a 1-token suffix
+    engine.generate(prompt, 6)  # indexes the blocks; warms prefill/decode
+    # warm the hit-path programs (restore + suffix chunk) outside the guard
+    slot = engine.admit_many([(prompt, 6)])[0]
+    while engine._active[slot] or engine.has_pending_events:
+        engine.step()
+    hits_before = engine.prefix_cache.hits
+    with jax.transfer_guard_host_to_device("disallow"):
+        slot = engine.admit_many([(prompt, 6)])[0]  # full-block hit
+        for _ in range(3):
+            engine.step()
+    assert engine.prefix_cache.hits == hits_before + 1
+
+
+@pytest.fixture
+def eager_prefill_allowed(monkeypatch):
+    """Re-allow implicit transfers inside speculative ``_prefill`` only.
+
+    Prefill runs the model EAGERLY (compiling it would pay one XLA compile per
+    prompt length — the retrace churn rule 2 flags), and eager ops materialize
+    python scalar constants through the host by design. The steady state the
+    regression pins is the ROUND LOOP; prefill is its warm-up, so the guard is
+    scoped around it, not over it."""
+    import unionml_tpu.models.speculative as spec_mod
+
+    real_prefill = spec_mod._prefill
+
+    def prefill_with_transfers_allowed(*args, **kwargs):
+        with jax.transfer_guard_host_to_device("allow"):
+            return real_prefill(*args, **kwargs)
+
+    monkeypatch.setattr(spec_mod, "_prefill", prefill_with_transfers_allowed)
+
+
+def test_speculative_round_loop_is_transfer_guard_clean(gpt, eager_prefill_allowed):
+    """ISSUE-4 satellite: the speculative steady state under the transfer
+    guard. After a warm-up call compiles the round programs,
+    ``speculative_generate`` runs with implicit host→device transfers
+    disallowed everywhere but the eager prefill — the per-round feeds are
+    explicit ``device_put``s — and produces the identical completion."""
+    import jax.numpy as jnp
+
+    from unionml_tpu.models.speculative import speculative_generate
+
+    model, variables = gpt
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)  # on device before the guard
+    key = jax.random.PRNGKey(7)
+    warm = speculative_generate(model, variables, model, variables, prompt, 8,
+                                gamma=2, rng=key)
+    with jax.transfer_guard_host_to_device("disallow"):
+        out = speculative_generate(model, variables, model, variables, prompt, 8,
+                                   gamma=2, rng=key)
+    np.testing.assert_array_equal(np.asarray(warm), np.asarray(out))
+
+
+def test_speculative_batcher_request_path_transfer_guard(gpt, eager_prefill_allowed):
+    """The SpeculativeBatcher's request path outside prefill stays guard-clean:
+    the entry upload is an explicit ``device_put``. Driven through ``_run``
+    directly because the transfer guard is thread-local and the public
+    ``generate`` hops to an executor thread."""
+    from unionml_tpu.serving.speculative import SpeculativeBatcher
+
+    model, variables = gpt
+    sb = SpeculativeBatcher(model, variables, model, variables, gamma=2, max_len=64)
+    prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+    warm = sb._run(prompt, 4, 0.0, None)  # compiles the round programs
+    with jax.transfer_guard_host_to_device("disallow"):
+        tokens = sb._run(prompt, 4, 0.0, None)
+    assert tokens == warm  # greedy: the guarded run decodes the same stream
+    assert sb.engine.tokens_decoded == len(warm) + len(tokens)
+
+
 # ------------------------------------------------------------- observability
 
 

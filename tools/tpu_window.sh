@@ -2,7 +2,9 @@
 # Run the full queued TPU measurement battery during a tunnel-up window.
 #
 # The remote-TPU tunnel (axon relay) has been up for only minutes at a time
-# (TPU_PROBES.log), so every hardware task is time-bounded and ordered by value:
+# (TPU_PROBES.log), so every hardware task is time-bounded and ordered by value.
+# A graftlint pass (python -m unionml_tpu.analysis) gates the battery first —
+# it needs no tunnel and a finding invalidates the numbers a window would buy:
 #   1. bench.py            — headline BERT-base fine-tune throughput + MFU
 #   2. bench_kernels.py    — pallas-vs-XLA block sweep -> KERNEL_BENCH.json
 #   3. bench_serving.py    — HTTP p50/p99 -> SERVING_BENCH.json, plus the
@@ -23,7 +25,16 @@ LOCKFILE=.tpu_window.lock
 exec 9>"$LOCKFILE"
 if ! flock -n 9; then
   echo "$STAMP tpu_window.sh: another battery holds $LOCKFILE; aborting" >> TPU_PROBES.log
-  exit 3  # exit codes: 0 battery ok, 1 bench failed, 2 tunnel not live, 3 lock held
+  exit 3  # exit codes: 0 battery ok, 1 bench failed, 2 tunnel not live, 3 lock held, 4 lint findings
+fi
+
+# graftlint gate (CPU-only, no tunnel needed): refuse to spend a TPU window
+# measuring a tree with hot-path host-sync / retrace / sharding / lock findings
+# — the findings invalidate the serving numbers before they are taken
+if ! timeout 120 env JAX_PLATFORMS=cpu python -m unionml_tpu.analysis unionml_tpu/ --fail-on-findings \
+    > /tmp/tpu_lint.out 2>&1; then
+  echo "$STAMP tpu_window.sh: graftlint findings; aborting battery (see /tmp/tpu_lint.out)" >> TPU_PROBES.log
+  exit 4
 fi
 
 if ! timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then

@@ -1,0 +1,35 @@
+"""graftlint — a JAX-aware static-analysis pass over the serving stack.
+
+PRs 1–3 each shipped a hand-written regression test for a whole *class* of
+bug: the transfer-guard test for host→device leaks in ``DecodeEngine.step``,
+the threefry-partitionable parity pin, the cancel-mid-chunked-prefill race.
+This package is the mechanical version of those reviews: an AST linter that
+checks the invariants on every CI run instead of re-discovering them one
+incident at a time.
+
+Rules (see :mod:`docs/analysis.md <docs.analysis>` for the catalog):
+
+- ``host-sync`` — host syncs / implicit transfers inside jit-traced bodies or
+  on ``# graftlint: hot-path`` host paths (call-graph walk).
+- ``retrace`` — jitted-callable usage that retraces or recompiles per call.
+- ``sharding`` — ``PartitionSpec`` axis names checked against the mesh axes
+  the tree declares; ``NamedSharding`` built off a foreign mesh variable.
+- ``lock-discipline`` — writes to ``# guarded-by: <lock>`` host state outside
+  the owning lock.
+- ``suppression`` — always-on hygiene: every ``# graftlint: disable=`` needs a
+  known rule name and a reason string.
+
+Run it as ``python -m unionml_tpu.analysis unionml_tpu/`` (exit 1 on findings)
+or programmatically via :func:`run_lint`.
+"""
+
+from unionml_tpu.analysis.core import (  # noqa: F401
+    REPORT_VERSION,
+    Finding,
+    LintResult,
+    Project,
+    RULES,
+    run_lint,
+)
+
+__all__ = ["Finding", "LintResult", "Project", "RULES", "REPORT_VERSION", "run_lint"]

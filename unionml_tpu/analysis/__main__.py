@@ -1,0 +1,69 @@
+"""graftlint CLI: ``python -m unionml_tpu.analysis [paths] [--json OUT]``.
+
+Exit codes: 0 clean, 1 findings, 2 bad invocation. Findings always fail the
+run — ``--fail-on-findings`` exists so CI scripts state the contract
+explicitly; ``--no-fail-on-findings`` turns the run advisory (report only).
+"""
+
+import argparse
+import sys
+
+from unionml_tpu.analysis.core import RULES, run_lint
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m unionml_tpu.analysis",
+        description="graftlint: JAX-aware static analysis "
+                    "(host-sync, retrace, sharding, lock-discipline)",
+    )
+    parser.add_argument("paths", nargs="*", default=["unionml_tpu"],
+                        help="files or directories to lint (default: unionml_tpu)")
+    parser.add_argument("--rules", help="comma-separated rule subset (default: all)")
+    parser.add_argument("--json", metavar="OUT", dest="json_out",
+                        help="write the machine-readable report to OUT ('-' for stdout)")
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    parser.add_argument("--fail-on-findings", dest="fail", action="store_true", default=True,
+                        help="exit non-zero when findings remain (default)")
+    parser.add_argument("--no-fail-on-findings", dest="fail", action="store_false",
+                        help="advisory mode: report but exit 0")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        # import for registration side effects
+        from unionml_tpu.analysis import (  # noqa: F401
+            rules_host_sync, rules_locks, rules_retrace, rules_sharding,
+        )
+        for name in sorted(RULES):
+            print(f"{name:16s} {RULES[name].summary}")
+        print("suppression      (always on) graftlint comments need a known rule and a reason")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    try:
+        result = run_lint(args.paths or ["unionml_tpu"], rules)
+    except ValueError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    for finding in result.findings:
+        print(finding.format())
+    summary = (
+        f"graftlint: {len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} suppressed, {result.files} file(s)"
+    )
+    print(summary, file=sys.stderr if result.findings else sys.stdout)
+
+    if args.json_out:
+        payload = result.report_json() + "\n"
+        if args.json_out == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.json_out, "w") as fh:
+                fh.write(payload)
+
+    return 1 if (result.findings and args.fail) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,378 @@
+"""Project-wide AST index: functions, call edges, jit/shard_map trace roots.
+
+Static call resolution is deliberately best-effort (a linter, not a compiler):
+
+- ``f(...)`` resolves through the lexical scope chain — enclosing function's
+  nested defs, then module-level defs, then imports into other scanned modules.
+- ``self.m(...)`` resolves to the enclosing class's method ``m``.
+- ``alias.f(...)`` resolves when ``alias`` imports a scanned module.
+- anything else (callables from parameters, attributes of objects, returns of
+  factories) is skipped — unresolvable edges drop out of the walk rather than
+  producing noise.
+
+Trace roots (functions whose bodies run under tracing) are discovered from:
+``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` decorators, and first
+arguments of ``jax.jit(f, ...)`` / ``shard_map(f, ...)`` / ``pjit(f, ...)``
+calls. When a jit call's result is bound (``g = jax.jit(f)`` or
+``self._g = jax.jit(f)``), the binding is recorded as a *jitted callable* with
+its ``static_argnums`` / ``static_argnames`` so call sites can be checked.
+"""
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: wrapper callables whose first argument becomes a traced body
+_TRACING_WRAPPERS = {"jit", "shard_map", "pjit", "checkify", "grad", "value_and_grad", "vmap", "pmap"}
+#: of those, the ones that produce a *compiled, cached* callable (retrace rule)
+_JIT_WRAPPERS = {"jit", "pjit"}
+
+
+def _const(node: ast.AST):
+    """Literal value of a constant / tuple-of-constants node, else None."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [_const(e) for e in node.elts]
+        if all(v is not None for v in vals):
+            return tuple(vals)
+    return None
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FunctionInfo:
+    """One function/method definition and its outgoing call edges."""
+
+    def __init__(self, module: "ModuleIndex", qualname: str, node: ast.AST,
+                 class_name: Optional[str]) -> None:
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.class_name = class_name
+        self.traced = False  # body runs under jax tracing
+        self.marker: Optional[str] = None  # "hot-path" | "off-path"
+        #: raw call sites: (callee key candidates, Call node)
+        self.calls: List[Tuple[List[Tuple[str, str]], ast.Call]] = []
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module.name, self.qualname)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<fn {self.module.name}:{self.qualname}{' traced' if self.traced else ''}>"
+
+
+class JitBinding:
+    """A name bound to a compiled callable: ``g = jax.jit(f, static_...)``."""
+
+    def __init__(self, name: str, target: Optional[FunctionInfo],
+                 static_argnums: Tuple[int, ...], static_argnames: Tuple[str, ...],
+                 node: ast.Call) -> None:
+        self.name = name  # binding name ("g" or "self._g" normalized to "_g")
+        self.target = target
+        self.static_argnums = static_argnums
+        self.static_argnames = static_argnames
+        self.node = node
+        #: observed literal values per static position across call sites
+        self.call_sites: List[ast.Call] = []
+
+
+class ModuleIndex(ast.NodeVisitor):
+    """Per-module symbol table (functions, imports, aliases, jit bindings)."""
+
+    def __init__(self, source) -> None:
+        self.source = source
+        self.name = source.name
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: local name -> imported dotted target ("np" -> "numpy",
+        #: "init_cache" -> "unionml_tpu.models.gpt.init_cache")
+        self.imports: Dict[str, str] = {}
+        self.jit_bindings: Dict[str, JitBinding] = {}
+        #: string constants at module scope (axis-name vocabulary etc.)
+        self.str_constants: Dict[str, str] = {}
+        self._scope: List[str] = []
+        self._class: List[str] = []
+        self._loops = 0
+        #: jax.jit/partial(jax.jit) Call nodes seen inside loops (retrace rule)
+        self.jit_in_loop: List[ast.Call] = []
+        self.visit(source.tree)
+        self._attach_markers()
+
+    # ---------------------------------------------------------------- helpers
+
+    def alias_of(self, root: str) -> Optional[str]:
+        """The dotted import target a bare name refers to (None if local)."""
+        return self.imports.get(root)
+
+    def resolves_to(self, node: ast.AST, *targets: str) -> bool:
+        """True when the call's func node denotes any of the dotted ``targets``
+        (through import aliases: ``np.asarray`` -> ``numpy.asarray``)."""
+        name = dotted(node)
+        if name is None:
+            return False
+        root, _, rest = name.partition(".")
+        expanded = name
+        if root in self.imports:
+            expanded = self.imports[root] + (("." + rest) if rest else "")
+        return expanded in targets or name in targets
+
+    def _qual(self, name: str) -> str:
+        return ".".join(self._scope + [name]) if self._scope else name
+
+    def _attach_markers(self) -> None:
+        for line, marker in self.source.markers.items():
+            for fn in self.functions.values():
+                start = min(
+                    [fn.node.lineno] + [d.lineno for d in fn.node.decorator_list]
+                )
+                if start <= line <= fn.node.body[0].lineno - 1 or line == fn.node.lineno:
+                    fn.marker = marker
+                    break
+
+    # ---------------------------------------------------------------- imports
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module is None or node.level:
+            return  # relative imports: out of scope for a best-effort graph
+        for a in node.names:
+            self.imports[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    # -------------------------------------------------------------- definitions
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scope.append(node.name)
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+        self._scope.pop()
+
+    def _visit_function(self, node) -> None:
+        qual = self._qual(node.name)
+        info = FunctionInfo(self, qual, node, self._class[-1] if self._class else None)
+        self.functions[qual] = info
+        for dec in node.decorator_list:
+            if self._is_jit_expr(dec):
+                info.traced = True
+                static_nums, static_names = self._static_info(dec)
+                self.jit_bindings[qual] = JitBinding(qual, info, static_nums, static_names,
+                                                    dec if isinstance(dec, ast.Call) else node)
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # ------------------------------------------------------------- module consts
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self._scope and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            if isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
+                self.str_constants[node.targets[0].id] = node.value.value
+        self._bind_jit_result(node)
+        self.generic_visit(node)
+
+    # ------------------------------------------------------------------- loops
+
+    def visit_For(self, node):  # noqa: N802 - ast API
+        self._loops += 1
+        self.generic_visit(node)
+        self._loops -= 1
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loops += 1
+        self.generic_visit(node)
+        self._loops -= 1
+
+    # ------------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        wrapper = self._tracing_wrapper_name(node.func)
+        if wrapper:
+            self._register_traced_arg(node)
+            if wrapper in _JIT_WRAPPERS and self._loops:
+                self.jit_in_loop.append(node)
+        if self._scope:
+            owner = self._enclosing_function()
+            if owner is not None:
+                owner.calls.append((self._callee_candidates(node.func), node))
+        self.generic_visit(node)
+
+    def _enclosing_function(self) -> Optional[FunctionInfo]:
+        # innermost enclosing def in the qualname chain
+        for i in range(len(self._scope), 0, -1):
+            info = self.functions.get(".".join(self._scope[:i]))
+            if info is not None:
+                return info
+        return None
+
+    def _callee_candidates(self, func: ast.AST) -> List[Tuple[str, str]]:
+        """(module, qualname) candidates for one call's callee."""
+        out: List[Tuple[str, str]] = []
+        if isinstance(func, ast.Name):
+            # nested defs visible from the current scope, innermost first
+            for i in range(len(self._scope), -1, -1):
+                out.append((self.name, ".".join(self._scope[:i] + [func.id])))
+            target = self.imports.get(func.id)
+            if target and "." in target:
+                mod, _, fn = target.rpartition(".")
+                out.append((mod, fn))
+        elif isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self" and self._class:
+                out.append((self.name, f"{self._class[-1]}.{func.attr}"))
+            elif isinstance(base, ast.Name) and base.id in self.imports:
+                out.append((self.imports[base.id], func.attr))
+        return out
+
+    # --------------------------------------------------------------- jit plumbing
+
+    def _tracing_wrapper_name(self, func: ast.AST) -> Optional[str]:
+        """'jit'/'shard_map'/... when ``func`` denotes a tracing wrapper."""
+        name = dotted(func)
+        if name is None:
+            return None
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _TRACING_WRAPPERS:
+            return leaf
+        # partial(jax.jit, ...) used as a decorator factory is handled by
+        # _is_jit_expr; a bare partial call is not a wrapper
+        return None
+
+    def _is_jit_expr(self, node: ast.AST) -> bool:
+        """True for ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``."""
+        if isinstance(node, ast.Call):
+            leaf = (dotted(node.func) or "").rsplit(".", 1)[-1]
+            if leaf in _JIT_WRAPPERS:
+                return True
+            if leaf == "partial" and node.args:
+                return (dotted(node.args[0]) or "").rsplit(".", 1)[-1] in _JIT_WRAPPERS
+            return False
+        return (dotted(node) or "").rsplit(".", 1)[-1] in _JIT_WRAPPERS
+
+    def _static_info(self, node: ast.AST) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+        nums: Tuple[int, ...] = ()
+        names: Tuple[str, ...] = ()
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                val = _const(kw.value)
+                if kw.arg == "static_argnums" and val is not None:
+                    nums = tuple(val) if isinstance(val, tuple) else (val,)
+                if kw.arg == "static_argnames" and val is not None:
+                    names = tuple(val) if isinstance(val, tuple) else (val,)
+        return nums, names
+
+    def _register_traced_arg(self, call: ast.Call) -> None:
+        """Mark ``f`` traced for ``jit(f, ...)``-style calls."""
+        args = call.args
+        leaf = (dotted(call.func) or "").rsplit(".", 1)[-1]
+        if leaf == "partial":
+            args = call.args[1:]
+        if not args or not isinstance(args[0], ast.Name):
+            return
+        fname = args[0].id
+        for i in range(len(self._scope), -1, -1):
+            info = self.functions.get(".".join(self._scope[:i] + [fname]))
+            if info is not None:
+                info.traced = True
+                return
+
+    def _bind_jit_result(self, node: ast.Assign) -> None:
+        """Record ``g = jax.jit(f, ...)`` / ``self._g = jax.jit(f, ...)``."""
+        call = node.value
+        if not isinstance(call, ast.Call) or not self._is_jit_expr(call):
+            return
+        target = node.targets[0]
+        bind_name = None
+        if isinstance(target, ast.Name):
+            bind_name = target.id
+        elif isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            bind_name = target.attr
+        if bind_name is None:
+            return
+        fn_info = None
+        args = call.args
+        if (dotted(call.func) or "").rsplit(".", 1)[-1] == "partial":
+            args = call.args[1:]
+        if args and isinstance(args[0], ast.Name):
+            for i in range(len(self._scope), -1, -1):
+                cand = self.functions.get(".".join(self._scope[:i] + [args[0].id]))
+                if cand is not None:
+                    fn_info = cand
+                    break
+        nums, names = self._static_info(call)
+        self.jit_bindings[bind_name] = JitBinding(bind_name, fn_info, nums, names, call)
+
+
+class CallGraph:
+    """All modules' indexes plus reachability over resolved call edges."""
+
+    def __init__(self, modules: Sequence) -> None:
+        self.indexes: List[ModuleIndex] = [ModuleIndex(m) for m in modules]
+        self.by_key: Dict[Tuple[str, str], FunctionInfo] = {}
+        for idx in self.indexes:
+            for fn in idx.functions.values():
+                self.by_key[fn.key] = fn
+
+    def index_for(self, source) -> Optional[ModuleIndex]:
+        for idx in self.indexes:
+            if idx.source is source:
+                return idx
+        return None
+
+    def trace_roots(self) -> List[FunctionInfo]:
+        return [fn for fn in self.by_key.values() if fn.traced]
+
+    def hot_roots(self) -> List[FunctionInfo]:
+        return [fn for fn in self.by_key.values() if fn.marker == "hot-path"]
+
+    def reachable(self, roots: Sequence[FunctionInfo], *,
+                  stop_markers: Sequence[str] = (),
+                  skip_traced: bool = False) -> Set[Tuple[str, str]]:
+        """BFS over resolved call edges from ``roots``.
+
+        ``stop_markers`` prunes functions carrying those graftlint markers
+        (e.g. ``off-path`` branches of a hot root); ``skip_traced`` keeps a
+        host-side walk from descending into device-traced bodies.
+        """
+        seen: Set[Tuple[str, str]] = set()
+        frontier = [fn for fn in roots]
+        while frontier:
+            fn = frontier.pop()
+            if fn.key in seen:
+                continue
+            seen.add(fn.key)
+            for candidates, _node in fn.calls:
+                callee = self._resolve(candidates)
+                if callee is None or callee.key in seen:
+                    continue
+                if callee.marker in stop_markers:
+                    continue
+                if skip_traced and callee.traced:
+                    continue
+                frontier.append(callee)
+        return seen
+
+    def _resolve(self, candidates: Sequence[Tuple[str, str]]) -> Optional[FunctionInfo]:
+        for key in candidates:
+            fn = self.by_key.get(key)
+            if fn is not None:
+                return fn
+        return None

@@ -1,0 +1,312 @@
+"""graftlint core: findings, suppressions, source model, rule registry, runner.
+
+The linter is pure ``ast`` + source-comment analysis — it never imports the
+code it checks, so it runs in CI without a device (and without paying jax
+import time per file). Three source-comment conventions drive it:
+
+- ``# graftlint: disable=RULE[,RULE2] -- reason`` suppresses the named rules on
+  that line (inline) or on the next code line (standalone comment line). The
+  reason string is REQUIRED: a suppression without one is itself a finding
+  (rule ``suppression``), so every silenced site documents why it is safe.
+- ``# graftlint: hot-path`` on a ``def`` line declares a host-side hot root for
+  the host-sync call-graph walk (e.g. ``DecodeEngine.step``); jit/shard_map
+  bodies are discovered automatically and need no marker.
+- ``# graftlint: off-path`` on a ``def`` line prunes the walk at functions that
+  are reachable from a hot root but are not steady-state (admission, error
+  recovery, compile paths).
+- ``# guarded-by: <lock>`` on a ``self.x = ...`` line in ``__init__`` declares
+  the attribute's owning lock for the lock-discipline rule.
+"""
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: JSON report schema version (bump on any shape change; pinned by tests)
+REPORT_VERSION = 1
+
+#: a comment is a DIRECTIVE only when the linter's name is followed by a
+#: colon; prose comments that merely mention the linter by name are not parsed
+_DIRECTIVE_RE = re.compile(r"graftlint\s*:")
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\s*--\s*(?P<reason>.*\S))?\s*$"
+)
+_MARKER_RE = re.compile(r"#\s*graftlint:\s*(hot-path|off-path)\b")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    #: enclosing function/method qualname ("" at module/class level)
+    symbol: str = ""
+    suppressed: bool = False
+    #: the suppression's reason string (suppressed findings only)
+    reason: str = ""
+
+    def format(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{where}"
+
+    def as_dict(self) -> Dict[str, object]:
+        d = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "symbol": self.symbol,
+        }
+        if self.suppressed:
+            d["reason"] = self.reason
+        return d
+
+
+@dataclasses.dataclass
+class Suppression:
+    """One ``# graftlint: disable=...`` comment (parsed, usage-tracked)."""
+
+    rules: Tuple[str, ...]
+    reason: str
+    line: int  # the code line it applies to
+
+
+class SourceModule:
+    """One parsed source file: AST + per-line suppressions/markers/annotations."""
+
+    def __init__(self, path: Path, relpath: str, name: str, text: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        #: dotted module name when under a package root, else the bare stem
+        self.name = name
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=str(path))
+        #: code line -> Suppression
+        self.suppressions: Dict[int, Suppression] = {}
+        #: def line -> "hot-path" | "off-path"
+        self.markers: Dict[int, str] = {}
+        #: code line -> lock attribute name (from ``# guarded-by: <lock>``)
+        self.guards: Dict[int, str] = {}
+        #: malformed-comment findings emitted by the parse (rule ``suppression``)
+        self.comment_findings: List[Finding] = []
+        self._parse_comments()
+
+    def _iter_comments(self):
+        """(line, col, comment_text, standalone) for every REAL comment token —
+        tokenize-based so docstrings talking about the conventions never match."""
+        import io
+        import tokenize
+
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    line, col = tok.start
+                    standalone = not self.lines[line - 1][:col].strip()
+                    yield line, col, tok.string, standalone
+        except tokenize.TokenError:  # unterminated constructs: ast already parsed, skip
+            return
+
+    def _parse_comments(self) -> None:
+        for line, col, comment, standalone in self._iter_comments():
+            # a standalone comment line governs the next line's code
+            target = line + 1 if standalone else line
+            if _DIRECTIVE_RE.search(comment):
+                self._parse_graftlint_comment(line, col, comment, target)
+            guarded = _GUARDED_RE.search(comment)
+            if guarded:
+                self.guards[target] = guarded.group(1)
+
+    def _parse_graftlint_comment(self, line: int, col: int, comment: str, target: int) -> None:
+        marker = _MARKER_RE.search(comment)
+        if marker:
+            self.markers[line] = marker.group(1)
+            return
+        m = _SUPPRESS_RE.search(comment)
+        if m is None:
+            self.comment_findings.append(
+                Finding(
+                    "suppression", self.relpath, line, col,
+                    "unparseable graftlint comment (expected "
+                    "'# graftlint: disable=RULE -- reason' or a hot-path/off-path marker)",
+                )
+            )
+            return
+        rules = tuple(r.strip() for r in m.group(1).split(","))
+        reason = (m.group("reason") or "").strip()
+        if not reason:
+            self.comment_findings.append(
+                Finding(
+                    "suppression", self.relpath, line, col,
+                    f"suppression of {', '.join(rules)} requires a reason "
+                    "('# graftlint: disable=RULE -- why this is safe')",
+                )
+            )
+            return
+        unknown = [r for r in rules if r not in RULES and r != "all"]
+        if unknown:
+            self.comment_findings.append(
+                Finding(
+                    "suppression", self.relpath, line, col,
+                    f"suppression names unknown rule(s) {', '.join(unknown)} "
+                    f"(known: {', '.join(sorted(RULES))})",
+                )
+            )
+            return
+        self.suppressions[target] = Suppression(rules, reason, target)
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        sup = self.suppressions.get(line)
+        if sup and (rule in sup.rules or "all" in sup.rules):
+            return sup
+        return None
+
+
+class Rule:
+    """A registered lint rule: ``check(project)`` yields raw findings."""
+
+    def __init__(self, name: str, summary: str, check) -> None:
+        self.name = name
+        self.summary = summary
+        self.check = check
+
+
+#: rule registry: name -> Rule (populated by the rule modules at import)
+RULES: Dict[str, Rule] = {}
+
+
+def register(name: str, summary: str):
+    """Decorator registering ``check(project)`` under ``name``."""
+
+    def wrap(check):
+        RULES[name] = Rule(name, summary, check)
+        return check
+
+    return wrap
+
+
+def _module_name(path: Path) -> str:
+    """Dotted module name by walking up through ``__init__.py`` packages."""
+    parts = [path.stem] if path.stem != "__init__" else []
+    parent = path.parent
+    while (parent / "__init__.py").exists():
+        parts.insert(0, parent.name)
+        parent = parent.parent
+    return ".".join(parts) or path.stem
+
+
+def collect_files(paths: Sequence[str]) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    # never lint generated/compiled droppings
+    return [f for f in files if "__pycache__" not in f.parts]
+
+
+class Project:
+    """Every parsed module of one lint invocation plus the shared call graph."""
+
+    def __init__(self, paths: Sequence[str]) -> None:
+        # rule modules self-register on import; comment parsing validates
+        # disable= names against the registry, so load them first
+        from unionml_tpu.analysis import (  # noqa: F401
+            rules_host_sync, rules_locks, rules_retrace, rules_sharding,
+        )
+
+        self.paths = list(paths)
+        self.modules: List[SourceModule] = []
+        self.errors: List[Finding] = []
+        for f in collect_files(paths):
+            try:
+                text = f.read_text()
+                self.modules.append(SourceModule(f, str(f), _module_name(f), text))
+            except (SyntaxError, UnicodeDecodeError) as exc:
+                self.errors.append(
+                    Finding(
+                        "parse", str(f), getattr(exc, "lineno", 1) or 1, 0,
+                        f"file does not parse: {exc.msg if hasattr(exc, 'msg') else exc}",
+                    )
+                )
+        from unionml_tpu.analysis.callgraph import CallGraph
+
+        self.graph = CallGraph(self.modules)
+        self._by_name = {m.name: m for m in self.modules}
+
+    def module(self, name: str) -> Optional[SourceModule]:
+        return self._by_name.get(name)
+
+
+def run_lint(paths: Sequence[str], rules: Optional[Sequence[str]] = None) -> "LintResult":
+    """Lint ``paths`` with the selected (default: all) rules."""
+    # rule modules self-register on import (Project also does this, but rule
+    # selection below needs the registry before any Project exists)
+    from unionml_tpu.analysis import (  # noqa: F401
+        rules_host_sync, rules_locks, rules_retrace, rules_sharding,
+    )
+
+    selected = list(rules) if rules else sorted(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)} (known: {', '.join(sorted(RULES))})")
+    project = Project(paths)
+    active: List[Finding] = list(project.errors)
+    suppressed: List[Finding] = []
+    for mod in project.modules:
+        active.extend(mod.comment_findings)  # suppression hygiene is not optional
+    for name in selected:
+        for finding in RULES[name].check(project):
+            mod = next((m for m in project.modules if m.relpath == finding.path), None)
+            sup = mod.suppression_for(name, finding.line) if mod else None
+            if sup is not None:
+                suppressed.append(
+                    dataclasses.replace(finding, suppressed=True, reason=sup.reason)
+                )
+            else:
+                active.append(finding)
+    active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(paths=list(paths), rules=selected, files=len(project.modules),
+                      findings=active, suppressed=suppressed)
+
+
+@dataclasses.dataclass
+class LintResult:
+    """One lint run's outcome; ``report()`` is the machine-readable surface."""
+
+    paths: List[str]
+    rules: List[str]
+    files: int
+    findings: List[Finding]
+    suppressed: List[Finding]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def report(self) -> Dict[str, object]:
+        return {
+            "graftlint": REPORT_VERSION,
+            "paths": self.paths,
+            "rules": self.rules,
+            "files": self.files,
+            "counts": {"findings": len(self.findings), "suppressed": len(self.suppressed)},
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": [f.as_dict() for f in self.suppressed],
+        }
+
+    def report_json(self) -> str:
+        return json.dumps(self.report(), indent=2)

@@ -1,0 +1,157 @@
+"""Rule ``host-sync``: host synchronization / host↔device traffic in hot code.
+
+Two walks, two severity models:
+
+1. **Traced bodies** (functions reachable from any ``@jax.jit`` / ``shard_map``
+   body): here a host sync is a *correctness* hazard — ``np.asarray`` /
+   ``np.array`` on a tracer, ``.item()``, ``.block_until_ready()``,
+   ``jax.device_get``, explicit ``bool()/int()/float()`` conversions, and
+   implicit ``bool()`` via ``if``/``while`` tests built from ``jnp`` calls all
+   either fail at trace time or silently bake a constant into the program.
+2. **Host hot paths** (functions reachable from a ``# graftlint: hot-path``
+   root, pruned at ``# graftlint: off-path``): here the hazard is a *stall* —
+   ``.item()``, ``.block_until_ready()`` and ``jax.device_get`` serialize the
+   host on the device stream, which is exactly what the pipelined decode
+   engine exists to avoid. The designed once-per-tick fused fetch carries a
+   reasoned suppression; anything new fails CI.
+
+The walk is a call-graph traversal (``CallGraph.reachable``), not a syntactic
+scan: a helper three calls below ``DecodeEngine.step`` is as hot as ``step``.
+"""
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from unionml_tpu.analysis.callgraph import FunctionInfo, dotted
+from unionml_tpu.analysis.core import Finding, Project, register
+
+#: numpy entry points that force a tracer onto the host
+_NP_SYNCS = {"asarray", "array"}
+#: conversions that concretize an abstract value
+_CONVERSIONS = {"bool", "int", "float"}
+
+
+def _expr_mentions_shape(node: ast.AST) -> bool:
+    """Shape/size arithmetic is trace-time Python — never a sync."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) and sub.func.id == "len":
+            return True
+    return False
+
+
+def _jnp_call_in(node: ast.AST, idx) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted(sub.func) or ""
+            root = name.split(".", 1)[0]
+            target = idx.imports.get(root, root)
+            if target.startswith("jax.numpy") or target == "jax.numpy":
+                return True
+    return False
+
+
+def _finding(fn: FunctionInfo, node: ast.AST, message: str) -> Finding:
+    return Finding(
+        "host-sync", fn.module.source.relpath, node.lineno, node.col_offset,
+        message, symbol=fn.qualname,
+    )
+
+
+def _check_traced_body(fn: FunctionInfo) -> Iterator[Finding]:
+    idx = fn.module
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            root = name.split(".", 1)[0]
+            root_target = idx.imports.get(root, root)
+            if leaf in _NP_SYNCS and root_target == "numpy":
+                yield _finding(
+                    fn, node,
+                    f"{root}.{leaf}() inside a traced body concretizes its argument "
+                    "(TracerArrayConversionError on a tracer, baked constant otherwise); "
+                    "use jnp equivalents or hoist to the host",
+                )
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == "item" and not node.args:
+                yield _finding(fn, node, ".item() inside a traced body forces a host sync")
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == "block_until_ready":
+                yield _finding(fn, node, ".block_until_ready() inside a traced body is a host sync")
+            elif idx.resolves_to(node.func, "jax.device_get", "jax.device_put"):
+                yield _finding(
+                    fn, node,
+                    f"{dotted(node.func)}() inside a traced body moves data through the host",
+                )
+            elif isinstance(node.func, ast.Name) and node.func.id in _CONVERSIONS and node.args:
+                arg = node.args[0]
+                if not isinstance(arg, ast.Constant) and not _expr_mentions_shape(arg):
+                    yield _finding(
+                        fn, node,
+                        f"{node.func.id}() on a traced value concretizes it "
+                        "(ConcretizationTypeError or a baked constant)",
+                    )
+        elif isinstance(node, (ast.If, ast.While)) and _jnp_call_in(node.test, idx):
+            yield _finding(
+                fn, node.test,
+                "branching on a jnp expression inside a traced body is an implicit "
+                "bool() host sync; use jnp.where / lax.cond",
+            )
+
+
+def _check_host_hot_path(fn: FunctionInfo) -> Iterator[Finding]:
+    idx = fn.module
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" and not node.args:
+            yield _finding(
+                fn, node,
+                ".item() on the steady-state host path blocks on the device stream",
+            )
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "block_until_ready":
+            yield _finding(
+                fn, node,
+                ".block_until_ready() on the steady-state host path stalls dispatch-ahead",
+            )
+        elif idx.resolves_to(node.func, "jax.device_get"):
+            yield _finding(
+                fn, node,
+                "jax.device_get on the steady-state host path serializes host and device; "
+                "fuse fetches or move the consumer off-path",
+            )
+        elif isinstance(node.func, ast.Name) and node.func.id in _CONVERSIONS and node.args:
+            # only flag conversions of device-mirror state: the `_dev`-suffix
+            # convention marks arrays that live on device in steady state
+            names = {
+                sub.attr if isinstance(sub, ast.Attribute) else getattr(sub, "id", "")
+                for sub in ast.walk(node.args[0])
+            }
+            if any(n.endswith("_dev") for n in names if n):
+                yield _finding(
+                    fn, node,
+                    f"{node.func.id}() on a device-resident mirror fetches it to the host "
+                    "every tick; keep the decision on device or batch the fetch",
+                )
+
+
+@register(
+    "host-sync",
+    "host syncs/transfers inside traced bodies or hot host paths (call-graph walk)",
+)
+def check(project: Project) -> Iterator[Finding]:
+    graph = project.graph
+    traced: Set[Tuple[str, str]] = graph.reachable(graph.trace_roots())
+    hot: Set[Tuple[str, str]] = graph.reachable(
+        graph.hot_roots(), stop_markers=("off-path",), skip_traced=True
+    )
+    emitted: List[Tuple] = []
+    for key in sorted(traced):
+        fn = graph.by_key[key]
+        for f in _check_traced_body(fn):
+            emitted.append(f)
+    for key in sorted(hot - traced):
+        fn = graph.by_key[key]
+        for f in _check_host_hot_path(fn):
+            emitted.append(f)
+    yield from emitted

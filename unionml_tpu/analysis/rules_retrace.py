@@ -1,0 +1,128 @@
+"""Rule ``retrace``: compiled-callable usage patterns that retrace/recompile.
+
+Checks, in order of how often they bite in serving code:
+
+- **jit-in-loop** — ``jax.jit(...)`` called inside a ``for``/``while`` body
+  builds a fresh compiled callable (and cache entry) per iteration.
+- **static-literal variance** — a callable built with ``static_argnums`` /
+  ``static_argnames`` whose call sites pass two or more *distinct literal*
+  values in a static position compiles once per value, by design; flagging the
+  literals forces the ladder to be bounded and named (a variable drawn from a
+  bucket ladder passes silently — the linter cannot prove its range, the
+  author's ladder comment can).
+- **container literal in traced position** — a ``[...]``/``{...}`` display
+  passed to a jitted callable re-traces whenever its length changes, and
+  uploads host data implicitly each call.
+- **python scalar literal in traced position** — a bare ``3``/``0.5`` argument
+  commits a fresh weak-typed device scalar every call (an implicit transfer on
+  the hot path, and a dtype-promotion retrace hazard).
+- **mutable closure state** — a traced body that reads ``self.<attr>`` bakes
+  the attribute's *trace-time* value into the compiled program; later host
+  mutations silently never reach the device.
+"""
+
+import ast
+from typing import Dict, Iterator, List
+
+from unionml_tpu.analysis.callgraph import JitBinding, dotted
+from unionml_tpu.analysis.core import Finding, Project, register
+
+
+def _literal(node: ast.AST):
+    if isinstance(node, ast.Constant) and not isinstance(node.value, (str, bytes)):
+        return node.value
+    return None
+
+
+def _static_positions(binding: JitBinding, call: ast.Call, fn_node) -> List[int]:
+    """Positional indexes of ``call`` that land in static parameters."""
+    positions = set(binding.static_argnums)
+    if binding.static_argnames and fn_node is not None:
+        params = [a.arg for a in fn_node.args.args]
+        positions.update(i for i, p in enumerate(params) if p in binding.static_argnames)
+    return sorted(p for p in positions if p < len(call.args))
+
+
+@register("retrace", "jitted-callable call patterns that retrace or recompile per call")
+def check(project: Project) -> Iterator[Finding]:
+    for idx in project.graph.indexes:
+        relpath = idx.source.relpath
+
+        for node in idx.jit_in_loop:
+            yield Finding(
+                "retrace", relpath, node.lineno, node.col_offset,
+                "jax.jit called inside a loop builds (and caches) a new compiled "
+                "callable per iteration; hoist the jit out of the loop",
+            )
+
+        # ---- call sites of known jitted bindings (by leaf name, best-effort)
+        bindings: Dict[str, JitBinding] = {}
+        for name, b in idx.jit_bindings.items():
+            bindings[name.rsplit(".", 1)[-1]] = b
+        static_literals: Dict[tuple, Dict[int, set]] = {}
+        for fn in idx.functions.values():
+            for _cands, call in fn.calls:
+                leaf = (dotted(call.func) or "").rsplit(".", 1)[-1]
+                # strip the `self.` prefix form: self._g(...) -> _g
+                binding = bindings.get(leaf)
+                if binding is None:
+                    continue
+                binding.call_sites.append(call)
+                fn_node = binding.target.node if binding.target is not None else None
+                statics = set(_static_positions(binding, call, fn_node))
+                for i, arg in enumerate(call.args):
+                    if i in statics:
+                        lit = _literal(arg)
+                        if lit is not None:
+                            static_literals.setdefault((relpath, leaf), {}) \
+                                .setdefault(i, set()).add((lit, call.lineno, call.col_offset))
+                        continue
+                    if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                        yield Finding(
+                            "retrace", relpath, arg.lineno, arg.col_offset,
+                            f"container literal passed to jitted '{leaf}' in a traced "
+                            "position re-traces per structure and uploads host data "
+                            "each call; build the array once outside",
+                            symbol=fn.qualname,
+                        )
+                    elif _literal(arg) is not None:
+                        yield Finding(
+                            "retrace", relpath, arg.lineno, arg.col_offset,
+                            f"python scalar literal passed to jitted '{leaf}' in a "
+                            "traced position commits a fresh device scalar every call "
+                            "(implicit transfer + weak-type hazard); pass a "
+                            "device-resident array",
+                            symbol=fn.qualname,
+                        )
+        for (path, leaf), by_pos in static_literals.items():
+            for pos, entries in by_pos.items():
+                values = {v for v, _l, _c in entries}
+                if len(values) < 2:
+                    continue
+                for _v, line, col in sorted(entries, key=lambda e: e[1]):
+                    yield Finding(
+                        "retrace", path, line, col,
+                        f"static position {pos} of jitted '{leaf}' receives "
+                        f"{len(values)} distinct literal values across call sites — "
+                        "one full compile per value; bound the ladder or make the "
+                        "argument traced",
+                    )
+
+        # ---- traced bodies capturing mutable host state through `self`
+        for fn in idx.functions.values():
+            if not fn.traced:
+                continue
+            for node in ast.walk(fn.node):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    yield Finding(
+                        "retrace", relpath, node.lineno, node.col_offset,
+                        f"traced body '{fn.qualname}' reads self.{node.attr}: the value "
+                        "is baked in at trace time and host mutations never reach the "
+                        "compiled program; pass it as an argument",
+                        symbol=fn.qualname,
+                    )
+                    break  # one finding per body is enough signal

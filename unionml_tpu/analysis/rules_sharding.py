@@ -1,0 +1,132 @@
+"""Rule ``sharding``: PartitionSpec axis names and NamedSharding mesh hygiene.
+
+The mesh axis vocabulary is collected from the scanned tree itself, so the rule
+follows the code instead of a hard-coded list:
+
+- module-level ``*_AXIS = "name"`` string constants (``parallel/mesh.py`` owns
+  the canonical four: data / fsdp / tensor / sequence);
+- literal dict keys passed to ``make_mesh`` / ``MeshSpec.from_dict`` /
+  ``make_hybrid_mesh`` and literal ``Mesh(..., ("a", "b"))`` axis-name tuples.
+
+Checks:
+
+- **unknown axis** — a string literal inside ``PartitionSpec(...)`` / ``P(...)``
+  that names an axis no mesh in the tree declares. A typo here does not error
+  at runtime on a mesh without the axis — GSPMD just replicates, silently
+  giving up the sharding the spec promised.
+- **foreign mesh** — ``NamedSharding(X, ...)`` where the enclosing function has
+  mesh-like bindings (a ``mesh`` parameter/local or ``*_mesh`` names) and ``X``
+  is none of them: the sharding is built off a different mesh than the
+  enclosing context, which breaks the single-mesh invariant that every array
+  in one program family must share (mixing meshes forces XLA resharding or
+  fails downstream where the arrays meet).
+"""
+
+import ast
+from typing import Iterator, List, Set
+
+from unionml_tpu.analysis.callgraph import dotted
+from unionml_tpu.analysis.core import Finding, Project, register
+
+_MESH_BUILDERS = {"make_mesh", "make_hybrid_mesh", "from_dict", "Mesh"}
+_SPEC_NAMES = {"PartitionSpec", "P"}
+
+
+def _axis_vocabulary(project: Project) -> Set[str]:
+    vocab: Set[str] = set()
+    for idx in project.graph.indexes:
+        for name, value in idx.str_constants.items():
+            if name.endswith("_AXIS"):
+                vocab.add(value)
+        for node in ast.walk(idx.source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = (dotted(node.func) or "").rsplit(".", 1)[-1]
+            if leaf not in _MESH_BUILDERS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Dict):
+                    for key in arg.keys:
+                        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                            vocab.add(key.value)
+                elif isinstance(arg, (ast.Tuple, ast.List)):
+                    for el in arg.elts:
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                            vocab.add(el.value)
+    return vocab
+
+
+def _spec_axis_literals(call: ast.Call) -> List[ast.Constant]:
+    out: List[ast.Constant] = []
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append(arg)
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            out.extend(
+                el for el in arg.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            )
+    return out
+
+
+def _mesh_like_names(fn_node: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    args = fn_node.args
+    for a in list(args.args) + list(args.kwonlyargs) + list(getattr(args, "posonlyargs", [])):
+        if a.arg == "mesh" or a.arg.endswith("_mesh"):
+            names.add(a.arg)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and (t.id == "mesh" or t.id.endswith("_mesh")):
+                    names.add(t.id)
+                elif isinstance(t, ast.Name) and isinstance(node.value, ast.Call):
+                    leaf = (dotted(node.value.func) or "").rsplit(".", 1)[-1]
+                    if leaf in ("make_mesh", "make_hybrid_mesh", "Mesh", "build"):
+                        names.add(t.id)
+        elif isinstance(node, ast.withitem):
+            ctx = node.context_expr
+            if isinstance(ctx, ast.Call):
+                leaf = (dotted(ctx.func) or "").rsplit(".", 1)[-1]
+                if leaf in ("Mesh", "make_mesh") and isinstance(node.optional_vars, ast.Name):
+                    names.add(node.optional_vars.id)
+    return names
+
+
+@register("sharding", "PartitionSpec axes checked against declared mesh axes; mesh-variable hygiene")
+def check(project: Project) -> Iterator[Finding]:
+    vocab = _axis_vocabulary(project)
+    for idx in project.graph.indexes:
+        relpath = idx.source.relpath
+        for fn in idx.functions.values():
+            mesh_names = None  # computed lazily per function
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = (dotted(node.func) or "").rsplit(".", 1)[-1]
+                if leaf in _SPEC_NAMES:
+                    for lit in _spec_axis_literals(node):
+                        if vocab and lit.value not in vocab:
+                            yield Finding(
+                                "sharding", relpath, lit.lineno, lit.col_offset,
+                                f"PartitionSpec axis '{lit.value}' is not declared by any "
+                                f"mesh in the tree (known axes: {', '.join(sorted(vocab))}); "
+                                "a typo'd axis silently replicates instead of sharding",
+                                symbol=fn.qualname,
+                            )
+                elif leaf == "NamedSharding" and node.args:
+                    first = node.args[0]
+                    if not isinstance(first, ast.Name):
+                        continue  # self._mesh / call results: out of static reach
+                    if mesh_names is None:
+                        mesh_names = _mesh_like_names(fn.node)
+                    if mesh_names and first.id not in mesh_names \
+                            and not first.id.endswith("mesh"):
+                        yield Finding(
+                            "sharding", relpath, first.lineno, first.col_offset,
+                            f"NamedSharding built off '{first.id}' while the enclosing "
+                            f"context binds mesh variable(s) {', '.join(sorted(mesh_names))}; "
+                            "mixing meshes in one program family forces resharding or "
+                            "fails where the arrays meet",
+                            symbol=fn.qualname,
+                        )

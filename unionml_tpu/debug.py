@@ -69,6 +69,7 @@ class RetraceMonitor:
         self.name = name or getattr(fn, "__name__", "fn")
 
         def counted(*args, **kwargs):
+            # graftlint: disable=retrace -- the trace-time side effect IS the feature: this counter exists to count retraces
             self.traces += 1
             if self.traces > 1:
                 logger.warning("%s re-traced (trace #%d) — check for shape/dtype churn", self.name, self.traces)

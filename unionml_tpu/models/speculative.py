@@ -191,15 +191,25 @@ def speculative_generate(
     rng, sub = jax.random.split(rng)
     t_last = select(t_logits, sub)  # (1,)
 
-    emitted = [int(t_last[0])]
-    prev = int(prompt_ids[0, -1])  # penultimate committed token (see propose)
+    # device_get (host-bound) instead of eager `arr[idx]` int() casts: an eager
+    # getitem uploads its slice-start scalars, which the transfer-guard
+    # steady-state regression disallows
+    emitted = [int(np.asarray(jax.device_get(t_last))[0])]
+    prev = int(np.asarray(jax.device_get(prompt_ids))[0, -1])  # penultimate committed token (see propose)
     n = prompt_len
     rounds = accepted_total = 0
     while len(emitted) < max_new_tokens:
-        feed2 = jnp.asarray([[prev, emitted[-1]]], jnp.int32)
-        n_dev = jnp.asarray(n, jnp.int32)
+        # EXPLICIT device_put for the per-round uploads: the round loop is the
+        # speculative steady state, and implicit host→device transfers here are
+        # exactly what the transfer-guard regression (and graftlint host-sync)
+        # exist to catch — explicit placement keeps the guard green and the
+        # intent visible
+        feed2 = jax.device_put(np.asarray([[prev, emitted[-1]]], np.int32))
+        # both positions uploaded explicitly (an eager `n_dev - 1` would
+        # implicitly transfer the python 1 as a scalar constant)
+        n_minus1, n_dev = jax.device_put((np.int32(n - 1), np.int32(n)))
         proposals, draft_logit_rows, draft_cache, rng = propose(
-            draft_variables, draft_cache, feed2, n_dev - 1, rng
+            draft_variables, draft_cache, feed2, n_minus1, rng
         )
         a, emissions, target_cache, rng = verify(
             target_variables, target_cache, t_last, proposals, draft_logit_rows, n_dev, rng
@@ -209,13 +219,14 @@ def speculative_generate(
         new_tokens = [int(t) for t in np.asarray(jax.device_get(emissions))[:take]]
         emitted.extend(new_tokens)
         prev = emitted[-2]
-        t_last = jnp.asarray([emitted[-1]], jnp.int32)
+        t_last = jax.device_put(np.asarray([emitted[-1]], np.int32))
         n += take
         rounds += 1
         accepted_total += a
 
     out = jnp.concatenate(
-        [prompt_ids, jnp.asarray(emitted[:max_new_tokens], jnp.int32)[None, :]], axis=1
+        [prompt_ids, jax.device_put(np.asarray(emitted[:max_new_tokens], np.int32))[None, :]],
+        axis=1,
     )
     if return_stats:
         proposed = rounds * gamma

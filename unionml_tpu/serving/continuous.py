@@ -400,6 +400,15 @@ class DecodeEngine:
 
         self._chunk_fn = jax.jit(_chunk_apply, donate_argnums=(2,))
 
+        def _pick_last(logits, idx):
+            """Row ``idx`` of a batch-1 chunk's logits, selected IN-PROGRAM: an
+            eager ``logits[:, idx, :]`` lowers to dynamic_slice whose start
+            indices ride the host→device lane implicitly — which the
+            transfer-guard admission regression disallows."""
+            return jax.lax.dynamic_index_in_dim(logits[0], idx, axis=0, keepdims=False)[None, :]
+
+        self._pick_last_fn = jax.jit(_pick_last)
+
         def _insert(cache, lens, last_logits, local_cache, local_logits, slots, lengths):
             def put(full, local):
                 width = local.shape[2]
@@ -586,7 +595,14 @@ class DecodeEngine:
         a single point-update dispatch. Admission and cancel go through here —
         never a full host upload, which would roll back OTHER slots' in-flight
         device-side retirements — so step() pays zero per-tick host→device
-        transfers for any of these vectors."""
+        transfers for any of these vectors. The scalar uploads are one EXPLICIT
+        ``device_put`` (a python scalar at the jit boundary is an implicit
+        transfer, which the transfer-guard admission regression disallows)."""
+        scalars = jax.device_put((
+            np.int32(slot), np.bool_(is_active),
+            np.int32(min(int(budget), np.iinfo(np.int32).max)),
+            np.float32(temp), np.int32(top_k), np.float32(top_p),
+        ))
         (
             self._active_dev,
             self._remaining_dev,
@@ -596,10 +612,7 @@ class DecodeEngine:
         ) = self._slot_update_fn(
             self._active_dev, self._remaining_dev,
             self._temp_dev, self._top_k_dev, self._top_p_dev,
-            jnp.asarray(slot, jnp.int32), is_active,
-            jnp.asarray(min(int(budget), np.iinfo(np.int32).max), jnp.int32),
-            jnp.asarray(temp, jnp.float32), jnp.asarray(top_k, jnp.int32),
-            jnp.asarray(top_p, jnp.float32),
+            *scalars,
         )
 
     def add_request(
@@ -789,22 +802,27 @@ class DecodeEngine:
         suffix_len = int(prompt.size) - matched
         bucket = self.bucket_for(suffix_len)
         pad_len = matched + bucket  # exact: the suffix write never clamps
-        block_ids = jnp.asarray([node.block_id for node in path], dtype=jnp.int32)
+        # hit-admission uploads are EXPLICIT device_puts: this is one of the two
+        # hot entry points the transfer-guard regression drives under
+        # disallow-implicit, so every host array states its transfer
+        block_ids = jax.device_put(
+            np.asarray([node.block_id for node in path], dtype=np.int32)
+        )
         local_cache = self._restore_fn(self._pool, block_ids, pad_len)
         self.prefix_restore_dispatches += 1
         ids = np.zeros((1, bucket), dtype=np.int32)
         ids[0, :suffix_len] = prompt[matched:]
         logits, local_cache = self._chunk_fn(
-            self._variables, jnp.asarray(ids), local_cache,
-            jnp.asarray(matched, dtype=jnp.int32),
+            self._variables, jax.device_put(ids), local_cache,
+            jax.device_put(np.int32(matched)),
         )
         self.prefill_dispatches += 1
         self.prefill_tokens_computed += suffix_len
-        last = jnp.asarray(logits)[:, suffix_len - 1, :]
+        last = self._pick_last_fn(logits, jax.device_put(np.int32(suffix_len - 1)))
         self._cache, self._lens, self._last_logits = self._insert_fn(
             self._cache, self._lens, self._last_logits, local_cache, last,
-            jnp.asarray([slot], dtype=jnp.int32),
-            jnp.asarray([prompt.size], dtype=jnp.int32),
+            jax.device_put(np.asarray([slot], dtype=np.int32)),
+            jax.device_put(np.asarray([prompt.size], dtype=np.int32)),
         )
         self.prefix_cache.record_hit(matched)
         self._activate(slot, int(prompt.size), budget, temp, top_k, top_p)
@@ -831,10 +849,12 @@ class DecodeEngine:
         )
         if new:
             start = len(full) - len(new)  # new nodes are always the path's tail
-            dst = jnp.asarray([node.block_id for node in new], dtype=jnp.int32)
+            # explicit uploads: block saves run at retirement, INSIDE the
+            # steady-state step path the transfer guard disallows implicits on
+            dst = jax.device_put(np.asarray([node.block_id for node in new], dtype=np.int32))
             self._pool = self._save_fn(
-                self._pool, self._cache, jnp.asarray(slot, dtype=jnp.int32),
-                jnp.asarray(start, dtype=jnp.int32), dst, self._prefix_block_size,
+                self._pool, self._cache, jax.device_put(np.int32(slot)),
+                jax.device_put(np.int32(start)), dst, self._prefix_block_size,
             )
             self.prefix_save_dispatches += 1
         if full:
@@ -897,7 +917,7 @@ class DecodeEngine:
         }
         return True
 
-    def _advance_partials(self) -> None:
+    def _advance_partials(self) -> None:  # graftlint: off-path (admission work, not steady-state decode)
         """Run ONE chunk of every in-progress chunked prefill (called per tick,
         between decode dispatches); completed prefills insert + activate."""
         for slot in list(self._partials):
@@ -917,7 +937,9 @@ class DecodeEngine:
             if state["consumed"] < prompt.size:
                 continue
             # final chunk: logits at the prompt's last REAL token seed decoding
-            last = jnp.asarray(logits)[:, prompt.size - 1 - consumed, :]
+            last = self._pick_last_fn(
+                logits, jax.device_put(np.int32(prompt.size - 1 - consumed))
+            )
             self._cache, self._lens, self._last_logits = self._insert_fn(
                 self._cache, self._lens, self._last_logits, state["cache"], last,
                 jnp.asarray([slot], dtype=jnp.int32),
@@ -929,7 +951,7 @@ class DecodeEngine:
             )
             self._index_prompt(slot, prompt)
 
-    def reset(self) -> None:
+    def reset(self) -> None:  # graftlint: off-path (error recovery, not steady-state decode)
         """Reallocate device state and clear all slots.
 
         Required after a failed :meth:`step`: the step donates the cache/logits
@@ -1047,6 +1069,7 @@ class DecodeEngine:
         tokens, masks, _ = burst
         t0 = time.perf_counter()
         try:
+            # graftlint: disable=host-sync -- the ONE designed sync per tick: tokens+masks fused into a single device_get (PR-3 pipelined-decode contract)
             tokens_host, masks_host = map(np.asarray, jax.device_get((tokens, masks)))
         except Exception:
             self.reset()
@@ -1067,7 +1090,7 @@ class DecodeEngine:
             )
         return events
 
-    def step(self, lookahead: int = 1) -> List[StepEvent]:
+    def step(self, lookahead: int = 1) -> List[StepEvent]:  # graftlint: hot-path
         """Decode for every active slot; returns per-slot events.
 
         :param lookahead: number of decode steps fused into ONE device program and
@@ -1321,11 +1344,14 @@ class ContinuousBatcher:
     def __init__(self, engine: DecodeEngine, *, lookahead: int = 1) -> None:
         self._engine = engine
         self._lookahead = max(1, int(lookahead))
+        # guarded-by: _lock
         self._pending: "collections.deque[Tuple[np.ndarray, int, Dict[str, Any], Any]]" = collections.deque()
+        #: slot -> sink; worker-thread-only by design (admission fan-out and
+        #: event dispatch both run on the worker), so no guard is declared
         self._sinks: Dict[int, Any] = {}
         self._lock = threading.Lock()
         self._work = threading.Event()
-        self._closed = False
+        self._closed = False  # guarded-by: _lock
         self._worker: Optional[threading.Thread] = None
 
     @property
@@ -1398,7 +1424,7 @@ class ContinuousBatcher:
             logger.warning("sink %s delivery failed (consumer gone?); dropping request", method)
             return False
 
-    def _admit(self) -> None:
+    def _admit(self) -> None:  # graftlint: off-path (admission, not steady-state decode)
         while True:
             with self._lock:
                 free = self._engine.free_slots
@@ -1444,7 +1470,7 @@ class ContinuousBatcher:
             for slot, (*_, sink) in zip(slots, admissible):
                 self._sinks[slot] = sink
 
-    def _fail_all(self, exc: Exception) -> None:
+    def _fail_all(self, exc: Exception) -> None:  # graftlint: off-path (error path)
         """Fail every in-flight request and abandon the engine's slots."""
         for sink in self._sinks.values():
             self._deliver(sink, "fail", RuntimeError(str(exc)))
@@ -1478,7 +1504,7 @@ class ContinuousBatcher:
                 del self._sinks[event.slot]
                 self._deliver(sink, "finish")
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # graftlint: hot-path
         while True:
             with self._lock:
                 if self._closed and not self._pending and not self._sinks:

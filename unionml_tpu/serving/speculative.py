@@ -54,13 +54,14 @@ class SpeculativeBatcher:
         # persistent evolving key (same contract as DecodeEngine): identical
         # sampled requests must NOT return identical completions unless the
         # client pins an explicit seed
-        self._key = jax.random.PRNGKey(0)
+        self._key = jax.random.PRNGKey(0)  # guarded-by: _lock
         # the /stats view; num_slots=1 states the single-stream design honestly.
         # bucket_for is the route's prefill-validation hook: speculation prefills
         # at the exact prompt length (no bucket ladder), so identity is correct.
         # requests_admitted / tokens_decoded / prefill_tokens_computed mirror the
         # continuous engine's generation counters, so the stats route reports the
         # same shape whichever generator is plugged in
+        # guarded-by: _lock
         self.engine = SimpleNamespace(
             num_slots=1,
             num_active=0,
@@ -108,7 +109,7 @@ class SpeculativeBatcher:
                     self._target_variables,
                     self._draft,
                     self._draft_variables,
-                    jax.numpy.asarray(prompt)[None, :],
+                    jax.device_put(prompt)[None, :],  # explicit: keeps the entry path transfer-guard-clean
                     max_new_tokens,
                     gamma=self._gamma,
                     temperature=temperature,
@@ -116,9 +117,12 @@ class SpeculativeBatcher:
                 )
             finally:
                 self.engine.num_active = 0
-        tokens = [int(t) for t in np.asarray(out)[0, prompt.size :]]
-        self.engine.prefill_tokens_computed += int(prompt.size)
-        self.engine.tokens_decoded += len(tokens)
+            tokens = [int(t) for t in np.asarray(out)[0, prompt.size :]]
+            # counter updates stay under the lock: concurrent requests (each on
+            # its own executor thread) race read-modify-write otherwise — the
+            # lock-discipline lint finding that motivated this placement
+            self.engine.prefill_tokens_computed += int(prompt.size)
+            self.engine.tokens_decoded += len(tokens)
         return tokens
 
     async def generate(
