@@ -678,6 +678,158 @@ def bench_pipeline(modes=("on", "off"), n_requests: int = 8, max_new_tokens: int
     return out
 
 
+def bench_slo_mix(n_batch: int = 24, n_interactive: int = 8, num_slots: int = 4,
+                  batch_tokens: int = 48, interactive_tokens: int = 8,
+                  interactive_deadline_ms: float = 30_000.0, mesh_devices: int = 0):
+    """Mixed SLO workload: interactive (high priority, deadline) requests
+    arriving into a queue already flooded with batch work — the saturation
+    shape where the SCHEDULER, not the step function, sets tail latency.
+
+    A/B: the SLO scheduler (priority classes + aging + preempt-to-prefix-
+    cache) vs the same batcher in FIFO mode (arrival order, no preemption —
+    the pre-scheduler behavior). Reported per arm and per class: TTFT
+    p50/p95/p99 and inter-token latency percentiles (client-side, engine-level
+    over the asyncio batcher — no HTTP jitter), plus shed / preemption /
+    deadline-miss counters and the queue-wait EMA. The acceptance signal is
+    interactive-class p95 TTFT: FIFO makes an interactive arrival drain the
+    whole batch backlog first; the scheduler pops it to the front and, with no
+    free slot, preempts a batch victim into the prefix cache.
+    """
+    import asyncio
+    import contextlib
+
+    from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+    from unionml_tpu.serving.scheduler import SchedulerConfig, SchedulingError, SLOScheduler
+
+    config, model, variables = _bench_gpt()
+    mesh = _serving_mesh(mesh_devices, config.num_heads) if mesh_devices else None
+    rng = np.random.default_rng(0)
+    batch_prompts = [rng.integers(1, config.vocab_size, size=6).tolist() for _ in range(n_batch)]
+    inter_prompts = [rng.integers(1, config.vocab_size, size=6).tolist() for _ in range(n_interactive)]
+
+    def pct(xs):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        pick = lambda q: round(xs[min(int(len(xs) * q), len(xs) - 1)], 2)
+        return {"p50_ms": pick(0.5), "p95_ms": pick(0.95), "p99_ms": pick(0.99)}
+
+    def warm(engine, fifo: bool):
+        """Warm every program the timed window can hit, so TTFT measures
+        SCHEDULING, not XLA compiles: the multi-row bucket prefill, the decode
+        step, and — scheduler arm only — the preempt-to-prefix-cache ladder
+        (restore / block-save / suffix-prefill compile once per
+        transcript-block-count shape)."""
+        warm_rng = np.random.default_rng(1)
+        for rows in range(1, num_slots + 1):
+            # admission pops 1..num_slots requests per wave: every (rows,
+            # bucket) prefill shape can appear in the timed window
+            prompts = [warm_rng.integers(1, config.vocab_size, size=6).tolist()
+                       for _ in range(rows)]
+            engine.admit_many([(p, 2) for p in prompts])
+            while engine.num_active:
+                engine.step()
+        if fifo:
+            return
+        for steps in range(4, batch_tokens, 8):
+            prompt = warm_rng.integers(1, config.vocab_size, size=6).tolist()
+            slot = engine.add_request(prompt, batch_tokens + 1)
+            for _ in range(steps):
+                engine.step()
+            state = engine.preempt(slot)
+            if state is None:
+                continue
+            engine.add_request(state.tokens, batch_tokens + 1 - (len(state.tokens) - 6))
+            engine.release_preempted(state)
+            while engine.num_active:
+                engine.step()
+
+    def run(fifo: bool):
+        engine = DecodeEngine(
+            model, variables, num_slots=num_slots, max_len=128, prefill_buckets=(8,),
+            mesh=mesh, prefix_cache_blocks=128, prefix_block_size=8,
+        )
+        warm(engine, fifo)
+        scheduler = SLOScheduler(
+            SchedulerConfig(fifo=fifo, preempt=not fifo, max_queue=4096)
+        )
+        batcher = ContinuousBatcher(engine, scheduler=scheduler)
+        ttft = {"interactive": [], "batch": []}
+        itl = {"interactive": [], "batch": []}
+        outcomes = {"completed": 0, "shed": 0, "deadline_missed": 0}
+
+        async def one(cls, prompt, n, deadline_ms):
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            last = None
+            try:
+                agen = batcher.stream(prompt, n, priority=cls, deadline_ms=deadline_ms)
+                async with contextlib.aclosing(agen) as it:
+                    async for _ in it:
+                        now = loop.time()
+                        if last is None:
+                            ttft[cls].append((now - t0) * 1e3)
+                        else:
+                            itl[cls].append((now - last) * 1e3)
+                        last = now
+                outcomes["completed"] += 1
+            except SchedulingError as exc:
+                key = "deadline_missed" if exc.reason == "deadline_exceeded" else "shed"
+                outcomes[key] += 1
+
+        async def drive():
+            t0 = time.perf_counter()
+            tasks = [
+                asyncio.ensure_future(one("batch", p, batch_tokens, None))
+                for p in batch_prompts
+            ]
+            await asyncio.sleep(0.05)  # the batch flood owns the queue first
+            for p in inter_prompts:  # interactive arrivals trickle in behind it
+                tasks.append(
+                    asyncio.ensure_future(
+                        one("interactive", p, interactive_tokens, interactive_deadline_ms)
+                    )
+                )
+                await asyncio.sleep(0.01)
+            await asyncio.gather(*tasks)
+            return time.perf_counter() - t0
+
+        total_s = asyncio.run(drive())
+        stats = scheduler.stats()
+        batcher.close()
+        return {
+            "total_s": round(total_s, 4),
+            "ttft_interactive": pct(ttft["interactive"]),
+            "ttft_batch": pct(ttft["batch"]),
+            "itl_interactive": pct(itl["interactive"]),
+            "itl_batch": pct(itl["batch"]),
+            "outcomes": outcomes,
+            "queue_wait_ema_ms": stats["queue_wait_ema_ms"],
+            "sheds": stats["shed_queue_full"] + stats["shed_deadline_infeasible"],
+            "preemptions": stats["preemptions"],
+            "deadline_misses": stats["deadline_misses_queued"] + stats["deadline_misses_running"],
+        }
+
+    scheduled = run(fifo=False)
+    fifo = run(fifo=True)
+    out = {
+        "n_batch": n_batch,
+        "n_interactive": n_interactive,
+        "num_slots": num_slots,
+        "batch_tokens": batch_tokens,
+        "interactive_tokens": interactive_tokens,
+        "interactive_deadline_ms": interactive_deadline_ms,
+        "mesh_devices": mesh_devices or 1,
+        "scheduler": scheduled,
+        "fifo": fifo,
+    }
+    sp95 = (scheduled["ttft_interactive"] or {}).get("p95_ms")
+    fp95 = (fifo["ttft_interactive"] or {}).get("p95_ms")
+    if sp95 and fp95:
+        out["interactive_p95_ttft_speedup"] = round(fp95 / sp95, 2)
+    return out
+
+
 def bench_speculative(iters: int = 20, max_new_tokens: int = 32, gamma: int = 4):
     """Speculative vs plain single-stream /generate latency over real HTTP.
 
@@ -752,6 +904,13 @@ def main():
                         help="also bench the prefix-heavy mix (N requests sharing a K-token "
                         "prefix): KV prefix-cache ON vs OFF — prefill tokens recomputed, "
                         "cache hit rate, prefill dispatches")
+    parser.add_argument("--slo-mix", action="store_true",
+                        help="focused SLO-scheduler phase: mixed interactive (high "
+                        "priority, deadline) + batch workload through the asyncio "
+                        "batcher, scheduler-on vs FIFO A/B — per-class TTFT/ITL "
+                        "p50/p95/p99 plus shed/preempt/deadline-miss counts. Runs "
+                        "ONLY this phase (like --pipeline); combine with --mesh N "
+                        "to run it over an N-device mesh")
     parser.add_argument("--pipeline", choices=("on", "off", "ab"), default=None,
                         help="focused depth-1 pipelined-decode phase: decode tok/s + "
                         "host-gap ms at lookahead=1 with dispatch-ahead on/off "
@@ -773,12 +932,14 @@ def main():
     from bench_util import resolve_artifact_path
 
     backend = jax.default_backend()
-    if args.pipeline or args.mesh:
+    if args.pipeline or args.mesh or args.slo_mix:
         import os
 
         base, ext = os.path.splitext(args.out)
         if args.pipeline:
             base = f"{base}_pipeline"
+        if args.slo_mix:
+            base = f"{base}_slo"
         if args.mesh:
             base = f"{base}_mesh{args.mesh}"
         args.out = f"{base}{ext}"
@@ -789,6 +950,29 @@ def main():
         "cold_start_excluded": True,
         "models": {},
     }
+
+    if args.slo_mix:
+        if args.mesh and len(jax.devices()) < args.mesh:
+            print(json.dumps({"metric": "slo_interactive_p95_ttft_ms",
+                              "error": f"--mesh {args.mesh} needs {args.mesh} devices, "
+                              f"found {len(jax.devices())}", "backend": backend}))
+            return 1
+        mix = bench_slo_mix(mesh_devices=args.mesh)
+        results["models"]["slo_mix" + (f"_mesh{args.mesh}" if args.mesh else "")] = mix
+        line = {"metric": "slo_interactive_p95_ttft_ms", "backend": backend,
+                "mesh_devices": args.mesh or 1,
+                "scheduler": (mix["scheduler"]["ttft_interactive"] or {}).get("p95_ms"),
+                "fifo": (mix["fifo"]["ttft_interactive"] or {}).get("p95_ms"),
+                "preemptions": mix["scheduler"]["preemptions"],
+                "deadline_misses": mix["scheduler"]["deadline_misses"],
+                "sheds": mix["scheduler"]["sheds"]}
+        if "interactive_p95_ttft_speedup" in mix:
+            line["speedup"] = mix["interactive_p95_ttft_speedup"]
+        print(json.dumps(line))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        return 0
 
     if args.pipeline:
         if args.mesh and len(jax.devices()) < args.mesh:

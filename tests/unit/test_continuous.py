@@ -237,32 +237,33 @@ def test_generate_route_over_http(gpt):
             batch = (await resp.json())["completions"]
 
             resp = await client.post("/generate", json={})
-            assert resp.status == 422
+            assert resp.status == 400
+            assert (await resp.json())["reason"] == "invalid_request"
 
             resp = await client.post(
                 "/generate", json={"prompt_ids": list(range(100)), "max_new_tokens": 4}
             )
-            assert resp.status == 422
+            assert resp.status == 400
 
             resp = await client.post(
                 "/generate", json={"prompt_ids": [1, 2], "max_new_tokens": [32]}
             )
-            assert resp.status == 422  # malformed budget is a client error, not a 500
+            assert resp.status == 400  # malformed budget is a client error, not a 500
 
             resp = await client.post(
                 "/generate", json={"prompt_ids": [1, None], "max_new_tokens": 4}
             )
-            assert resp.status == 422  # non-numeric token is a client error
+            assert resp.status == 400  # non-numeric token is a client error
 
             resp = await client.post("/generate", json={"prompts": 123, "max_new_tokens": 4})
-            assert resp.status == 422  # non-list prompts is a client error
+            assert resp.status == 400  # non-list prompts is a client error
 
             # one bad prompt rejects the whole batch BEFORE any slot is scheduled
             resp = await client.post(
                 "/generate",
                 json={"prompts": [[2, 7], list(range(100))], "max_new_tokens": 4},
             )
-            assert resp.status == 422
+            assert resp.status == 400
             resp = await client.get("/stats")
             assert (await resp.json())["generation"]["active"] == 0
 
@@ -342,7 +343,7 @@ def test_stream_route_ndjson(gpt):
             resp = await client.post(
                 "/generate", json={"prompts": [[1, 2]], "max_new_tokens": 2, "stream": True}
             )
-            assert resp.status == 422  # streaming is single-prompt only
+            assert resp.status == 400  # streaming is single-prompt only
             return lines
         finally:
             await client.close()
@@ -482,7 +483,7 @@ def test_batcher_lookahead_matches_solo(gpt):
 
 
 def test_generate_route_sampling_params(gpt):
-    """HTTP sampling controls: top_k=1 reduces to greedy; bad params 422."""
+    """HTTP sampling controls: top_k=1 reduces to greedy; bad params 400."""
     import types
 
     from aiohttp.test_utils import TestClient, TestServer
@@ -523,7 +524,7 @@ def test_generate_route_sampling_params(gpt):
                 resp = await client.post(
                     "/generate", json={"prompt_ids": [3, 1, 4], "max_new_tokens": 2, **bad}
                 )
-                assert resp.status == 422, (bad, await resp.text())
+                assert resp.status == 400, (bad, await resp.text())
         finally:
             await client.close()
 

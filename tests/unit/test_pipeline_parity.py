@@ -359,7 +359,8 @@ def test_speculative_round_loop_is_transfer_guard_clean(gpt, eager_prefill_allow
 
 def test_speculative_batcher_request_path_transfer_guard(gpt, eager_prefill_allowed):
     """The SpeculativeBatcher's request path outside prefill stays guard-clean:
-    the entry upload is an explicit ``device_put``. Driven through ``_run``
+    the entry upload is an explicit ``device_put``. Driven through
+    ``_run_current`` (the device-work half below the scheduler's turn-taking)
     directly because the transfer guard is thread-local and the public
     ``generate`` hops to an executor thread."""
     from unionml_tpu.serving.speculative import SpeculativeBatcher
@@ -367,9 +368,9 @@ def test_speculative_batcher_request_path_transfer_guard(gpt, eager_prefill_allo
     model, variables = gpt
     sb = SpeculativeBatcher(model, variables, model, variables, gamma=2, max_len=64)
     prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
-    warm = sb._run(prompt, 4, 0.0, None)  # compiles the round programs
+    warm = sb._run_current(prompt, 4, 0.0, None)  # compiles the round programs
     with jax.transfer_guard_host_to_device("disallow"):
-        tokens = sb._run(prompt, 4, 0.0, None)
+        tokens = sb._run_current(prompt, 4, 0.0, None)
     assert tokens == warm  # greedy: the guarded run decodes the same stream
     assert sb.engine.tokens_decoded == len(warm) + len(tokens)
 

@@ -97,7 +97,7 @@ def test_speculative_batcher_serves_generate_route(pair):
             bad = await client.post(
                 "/generate", json={"prompt_ids": [1], "max_new_tokens": 4, "top_p": 0.5}
             )
-            assert bad.status == 422
+            assert bad.status == 400
         finally:
             await client.close()
 

@@ -9,8 +9,9 @@
 #   2. bench_kernels.py    — pallas-vs-XLA block sweep -> KERNEL_BENCH.json
 #   3. bench_serving.py    — HTTP p50/p99 -> SERVING_BENCH.json, plus the
 #                            prefill-heavy admission mix, the prefix-heavy
-#                            shared-prompt mix (KV prefix cache on/off), and
-#                            (--mesh 4) the tensor-parallel sharded-engine path
+#                            shared-prompt mix (KV prefix cache on/off),
+#                            (--mesh 4) the tensor-parallel sharded-engine path,
+#                            and (--slo-mix) the SLO-scheduler-vs-FIFO A/B
 # Each step's JSON artifact is committed by the caller if it changed.
 set -u
 cd "$(dirname "$0")/.."
@@ -83,6 +84,9 @@ run serving_mesh 420 python bench_serving.py --mesh 4
 # depth-1 pipelined decode A/B: dispatch-ahead on vs off at lookahead=1 —
 # decode tok/s + host-gap ms (the host sync this battery's tunnel magnifies)
 run serving_pipeline 300 python bench_serving.py --pipeline ab
+# SLO scheduler A/B: mixed interactive+batch load, scheduler vs FIFO —
+# per-class TTFT p50/p95/p99 + shed/preempt/deadline-miss counts
+run serving_slo 300 python bench_serving.py --slo-mix
 # most expensive phase last: ~1.3B-param decode, bf16 vs int8 weight-only
 run int8 600 python bench_int8.py
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log

@@ -5,6 +5,7 @@ from typing import Any, Optional
 from unionml_tpu.serving.app import build_aiohttp_app, jsonable, load_model_artifact, run_app
 from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
 from unionml_tpu.serving.prefix_cache import PrefixCache
+from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
 from unionml_tpu.serving.speculative import SpeculativeBatcher
 from unionml_tpu.serving.resident import ResidentPredictor
 
@@ -61,6 +62,8 @@ __all__ = [
     "DecodeEngine",
     "PrefixCache",
     "ResidentPredictor",
+    "SLOScheduler",
+    "SchedulerConfig",
     "build_aiohttp_app",
     "jsonable",
     "load_model_artifact",

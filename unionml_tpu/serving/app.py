@@ -91,6 +91,7 @@ def build_aiohttp_app(
     generate_lookahead: int = 1,
     generate_prefix_cache_blocks: int = 0,
     generate_prefix_block_size: int = 16,
+    generate_scheduler: Optional[Any] = None,
     mesh: Optional[Any] = None,
     param_specs: Optional[Any] = None,
 ):
@@ -128,6 +129,16 @@ def build_aiohttp_app(
     restore its KV from a device block pool and prefill only their suffix.
     Cache hit/eviction counters surface under ``GET /stats`` →
     ``generation.prefix_cache``.
+
+    ``generate_scheduler`` configures the SLO admission scheduler when the app
+    wraps a bare engine (a
+    :class:`~unionml_tpu.serving.scheduler.SchedulerConfig` or a prebuilt
+    :class:`~unionml_tpu.serving.scheduler.SLOScheduler`; ``None`` = default
+    policy). ``/generate`` payloads may carry ``priority``
+    (``interactive``/``standard``/``batch``) and ``deadline_ms``; overload
+    sheds map to HTTP 429/503 with ``Retry-After``, deadline expiry to 504,
+    invalid requests to 400 — each with a machine-readable ``reason`` — and
+    scheduler counters surface under ``GET /stats`` → ``generation.scheduler``.
     """
     from aiohttp import web
 
@@ -180,7 +191,9 @@ def build_aiohttp_app(
                         generate_prefix_cache_blocks, generate_prefix_block_size
                     )
             if isinstance(built, DecodeEngine):
-                built = ContinuousBatcher(built, lookahead=generate_lookahead)
+                built = ContinuousBatcher(
+                    built, lookahead=generate_lookahead, scheduler=generate_scheduler
+                )
             app["continuous_batcher"] = built
         logger.info("Serving app ready (model=%s).", model.name)
 
@@ -242,31 +255,64 @@ def build_aiohttp_app(
             logger.exception("Prediction failed")
             return web.json_response({"detail": f"Prediction failed: {exc}"}, status=500)
 
+    def _bad_request(detail, reason="invalid_request"):
+        """Client-side rejection: machine-readable ``reason`` + human detail."""
+        return web.json_response({"detail": detail, "reason": reason}, status=400)
+
+    def _scheduling_response(exc):
+        """Map a structured scheduling rejection to its HTTP contract:
+        queue-full sheds are 429, infeasible-deadline sheds are 503 (both with
+        ``Retry-After``), and deadline expiry is 504 — each carrying the
+        error's machine-readable ``reason`` so clients can branch without
+        parsing prose."""
+        from unionml_tpu.serving.scheduler import (
+            DeadlineExceededError,
+            DeadlineInfeasibleError,
+            QueueFullError,
+        )
+
+        if isinstance(exc, QueueFullError):
+            status = 429
+        elif isinstance(exc, DeadlineInfeasibleError):
+            status = 503
+        elif isinstance(exc, DeadlineExceededError):
+            status = 504
+        else:
+            status = 500
+        headers = {}
+        retry_after = getattr(exc, "retry_after_s", None)
+        if retry_after:
+            headers["Retry-After"] = str(max(1, int(round(retry_after))))
+        return web.json_response(
+            {"detail": str(exc), "reason": getattr(exc, "reason", "scheduling")},
+            status=status,
+            headers=headers,
+        )
+
     async def generate_route(request):
+        from unionml_tpu.serving.scheduler import SchedulingError, parse_priority
+
         gen = request.app.get("continuous_batcher")
         if gen is None:
             return web.json_response({"detail": "Generation is not enabled on this app."}, status=404)
         try:
             payload = await request.json()
         except Exception:
-            return web.json_response({"detail": "Request body must be JSON."}, status=422)
+            return _bad_request("Request body must be JSON.", reason="invalid_json")
         prompt_ids = payload.get("prompt_ids")
         prompts = payload.get("prompts")
         if prompt_ids is None and prompts is None:
-            return web.json_response(
-                {"detail": "prompt_ids (one prompt) or prompts (a batch) must be supplied."},
-                status=422,
-            )
+            return _bad_request("prompt_ids (one prompt) or prompts (a batch) must be supplied.")
         import asyncio
 
         try:
             max_new = int(payload.get("max_new_tokens", 32))
         except (TypeError, ValueError):
-            return web.json_response({"detail": "max_new_tokens must be an integer."}, status=422)
+            return _bad_request("max_new_tokens must be an integer.")
         if max_new < 1:
-            # pre-validated here so the streaming path can 422 BEFORE committing
-            # a 200 status line (the engine's own check would be too late)
-            return web.json_response({"detail": "max_new_tokens must be >= 1."}, status=422)
+            # pre-validated here so the streaming path can reject BEFORE
+            # committing a 200 status line (the engine's check would be too late)
+            return _bad_request("max_new_tokens must be >= 1.")
 
         try:
             # validate EVERY prompt before scheduling any: a bad prompt in a
@@ -281,7 +327,27 @@ def build_aiohttp_app(
                     raise ValueError(f"prompt length {seq.size} >= max_len ({gen.engine.max_len})")
                 gen.engine.bucket_for(seq.size)
         except (TypeError, ValueError) as exc:
-            return web.json_response({"detail": f"invalid prompt payload: {exc}"}, status=422)
+            return _bad_request(f"invalid prompt payload: {exc}")
+
+        # optional SLO fields: a priority class and a wall-clock deadline
+        # budget (ms, arrival -> completion), forwarded to the generator's
+        # scheduler only when present so custom generators without the
+        # scheduler kwargs keep working
+        slo = {}
+        if payload.get("priority") is not None:
+            try:
+                slo["priority"] = parse_priority(payload["priority"])
+            except ValueError as exc:
+                return _bad_request(str(exc))
+        if payload.get("deadline_ms") is not None:
+            deadline_ms = payload["deadline_ms"]
+            if (
+                isinstance(deadline_ms, bool)
+                or not isinstance(deadline_ms, (int, float))
+                or deadline_ms <= 0
+            ):
+                return _bad_request(f"deadline_ms must be a positive number, got {deadline_ms!r}")
+            slo["deadline_ms"] = float(deadline_ms)
 
         # optional per-request sampling controls (applied to every prompt in a
         # batch); absent keys defer to the engine's construction-time settings
@@ -294,7 +360,7 @@ def build_aiohttp_app(
                 payload.get("top_p") if payload.get("top_p") is not None else 1.0,
             )
         except (TypeError, ValueError) as exc:
-            return web.json_response({"detail": f"invalid sampling params: {exc}"}, status=422)
+            return _bad_request(f"invalid sampling params: {exc}")
         sampling = {}
         if payload.get("temperature") is not None:
             sampling["temperature"] = temp
@@ -304,39 +370,63 @@ def build_aiohttp_app(
             sampling["top_p"] = top_p
         stream = bool(payload.get("stream"))
         if stream and prompt_ids is None:
-            return web.json_response(
-                {"detail": "stream=true requires a single prompt_ids prompt."}, status=422
-            )
+            return _bad_request("stream=true requires a single prompt_ids prompt.")
         if stream:
+            import contextlib
             import json as _json
 
+            # pull the FIRST token before committing the 200 status line, so
+            # scheduling rejections (queue full / infeasible or expired
+            # deadline) surface as their real 429/503/504 statuses instead of
+            # an in-band error on a 200 stream
+            stream_it = gen.stream(prompt_ids, max_new, **slo, **sampling)
+            exhausted, first = False, None
+            try:
+                first = await anext(stream_it)
+            except StopAsyncIteration:
+                exhausted = True  # zero emitted tokens (e.g. immediate eos)
+            except SchedulingError as exc:
+                await stream_it.aclose()
+                return _scheduling_response(exc)
+            except ValueError as exc:
+                await stream_it.aclose()
+                return _bad_request(str(exc))
+            except Exception as exc:
+                await stream_it.aclose()
+                logger.exception("Generation failed")
+                return web.json_response({"detail": f"Generation failed: {exc}"}, status=500)
+
             # ndjson chunks: one {"token": N} line per decoded token, then a
-            # {"done": true, "tokens": [...]} trailer. Prompt validation already
-            # passed above; failures after prepare() can only be reported
-            # in-band as an {"error": ...} line (the status line is already out)
+            # {"done": true, "tokens": [...]} trailer. Failures from here on
+            # can only be reported in-band as an {"error": ...} line (the
+            # status line is already out)
             response = web.StreamResponse()
             response.content_type = "application/x-ndjson"
             await response.prepare(request)
             tokens = []
-            import contextlib
-
             try:
                 # aclosing guarantees the stream iterator closes promptly on an
                 # early exit (client disconnect -> write raises), which cancels
                 # the request's decode slot
-                async with contextlib.aclosing(
-                    gen.stream(prompt_ids, max_new, **sampling)
-                ) as stream_it:
-                    async for token in stream_it:
-                        tokens.append(token)
-                        await response.write((_json.dumps({"token": token}) + "\n").encode())
+                async with contextlib.aclosing(stream_it) as it:
+                    if not exhausted:
+                        tokens.append(first)
+                        await response.write((_json.dumps({"token": first}) + "\n").encode())
+                        async for token in it:
+                            tokens.append(token)
+                            await response.write((_json.dumps({"token": token}) + "\n").encode())
                 await response.write(
                     (_json.dumps({"done": True, "tokens": tokens}) + "\n").encode()
                 )
             except Exception as exc:
                 logger.warning("Streaming generation ended early: %s", exc)
+                line = {"error": str(exc)}
+                if isinstance(exc, SchedulingError):
+                    # a deadline expiring mid-stream lands here: the status is
+                    # committed, so the reason slug travels in-band instead
+                    line["reason"] = exc.reason
                 try:  # the transport may be the thing that failed
-                    await response.write((_json.dumps({"error": str(exc)}) + "\n").encode())
+                    await response.write((_json.dumps(line) + "\n").encode())
                 except Exception:
                     pass
             try:
@@ -346,14 +436,16 @@ def build_aiohttp_app(
             return response
         try:
             if prompt_ids is not None:
-                tokens = await gen.generate(prompt_ids, max_new, **sampling)
+                tokens = await gen.generate(prompt_ids, max_new, **slo, **sampling)
                 return web.json_response({"tokens": tokens})
             completions = await asyncio.gather(
-                *(gen.generate(p, max_new, **sampling) for p in prompts)
+                *(gen.generate(p, max_new, **slo, **sampling) for p in prompts)
             )
             return web.json_response({"completions": list(completions)})
+        except SchedulingError as exc:  # structured shed / deadline rejection
+            return _scheduling_response(exc)
         except ValueError as exc:  # bad request (empty/oversized prompt, bad budget)
-            return web.json_response({"detail": str(exc)}, status=422)
+            return _bad_request(str(exc))
         except Exception as exc:  # engine/worker failures are SERVER errors
             logger.exception("Generation failed")
             return web.json_response({"detail": f"Generation failed: {exc}"}, status=500)
@@ -388,6 +480,12 @@ def build_aiohttp_app(
                 payload["generation"]["prefill_tokens_computed"] = (
                     gen.engine.prefill_tokens_computed
                 )
+            sched = getattr(gen, "scheduler", None)
+            if sched is not None and callable(getattr(sched, "stats", None)):
+                # SLO scheduler observability: per-class queue depth,
+                # queue-wait EMA, shed / preemption / deadline-miss counters —
+                # the same block whichever generator kind is plugged in
+                payload["generation"]["scheduler"] = sched.stats()
         if batcher is not None:
             payload["coalescing"] = dict(batcher.stats)
             if batcher.ema_gap_ms is not None:

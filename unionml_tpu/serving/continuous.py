@@ -55,11 +55,16 @@ unchanged.
 
 ``DecodeEngine`` is the synchronous core (useful directly in scripts/tests);
 ``ContinuousBatcher`` runs it on a worker thread behind an asyncio API for the
-serving app's ``/generate`` route.
+serving app's ``/generate`` route — admission no longer runs off a bare FIFO
+deque but through the SLO scheduler (:mod:`unionml_tpu.serving.scheduler`):
+priority classes with anti-starvation aging, a bounded queue that sheds with
+structured errors, deadline enforcement on queued and running requests, and
+preempt-to-prefix-cache (:meth:`DecodeEngine.preempt`) that checkpoints a
+low-priority victim's KV into the PR-2 radix cache so a higher-priority
+arrival gets its slot and the victim resumes for one suffix prefill.
 """
 
 import asyncio
-import collections
 import dataclasses
 import threading
 import time
@@ -84,6 +89,26 @@ class StepEvent:
     #: False for an EOS token (consumed, not part of the completion)
     emit: bool
     finished: bool
+    #: time the request spent queued before admission (ms), attached to the
+    #: request's FIRST decoded token only — lets a TTFT measurement decompose
+    #: into queue wait vs prefill+decode (None on every later event, and for
+    #: requests admitted without a queue, e.g. direct ``add_request`` calls)
+    queue_wait_ms: Optional[float] = None
+
+
+@dataclasses.dataclass
+class PreemptedSlot:
+    """A preempted request's resumable checkpoint (:meth:`DecodeEngine.preempt`).
+
+    ``tokens`` is the slot's full transcript — prompt plus every token decoded
+    so far — which becomes the resume prompt; ``path`` is the radix-tree node
+    chain holding the transcript's KV blocks, PINNED against LRU eviction
+    until :meth:`DecodeEngine.release_preempted` (called after the resume
+    re-admission acquired its own references, or when the request is
+    cancelled while re-queued)."""
+
+    tokens: List[int]
+    path: List[Any]
 
 
 class DecodeEngine:
@@ -256,6 +281,12 @@ class DecodeEngine:
         #: kinds share — see serving.app and serving.speculative)
         self.requests_admitted = 0
         self.tokens_decoded = 0
+        #: running slots checkpointed into the prefix cache by :meth:`preempt`
+        self.preempted_requests = 0
+        #: per-slot queue wait (ms) noted by the batcher at admission
+        #: (:meth:`note_queue_wait`); attached to the slot's first StepEvent
+        self._slot_queue_wait: Dict[int, float] = {}
+        self.ema_queue_wait_ms: Optional[float] = None
         #: device-idle accounting: a dispatch is "idle" when the device queue
         #: was empty when it was enqueued (no in-flight step); the EMAs track
         #: the host gap the device sat idle (ms) and the time the host spent
@@ -574,8 +605,34 @@ class DecodeEngine:
 
         temperature, top_k, top_p = validate_sampling(temperature, top_k, top_p)
         temperature = self.temperature if temperature is None else temperature
-        self.bucket_for(prompt.size)  # raises for prompts beyond the bucket ladder
+        try:
+            self.bucket_for(prompt.size)  # raises for prompts beyond the bucket ladder
+        except ValueError:
+            # a cached prefix can stand in for the missing bucket: only the
+            # uncovered suffix runs prefill, so a preempted transcript longer
+            # than the largest bucket (its blocks pinned) still re-admits
+            if not self._prefix_coverable(prompt):
+                raise
         return prompt, int(max_new_tokens), float(temperature), int(top_k), float(top_p)
+
+    def _prefix_coverable(self, prompt: np.ndarray) -> bool:
+        """True when the cached prefix of ``prompt`` leaves a suffix that fits
+        the bucket ladder and the slot's cache rows — the admission path a
+        preempted transcript resumes through. A non-acquiring probe: the
+        actual match happens at admission (pinned resume blocks cannot be
+        evicted in between)."""
+        if self.prefix_cache is None:
+            return False
+        if self.prefill_chunk is not None and int(prompt.size) < self.max_len:
+            return True  # the chunked path handles any in-capacity suffix
+        block = self._prefix_block_size
+        covered = self.prefix_cache.probe(prompt, (int(prompt.size) - 1) // block) * block
+        if covered <= 0:
+            return False
+        try:
+            return covered + self.bucket_for(int(prompt.size) - covered) <= self.max_len
+        except ValueError:
+            return False
 
     def _activate(self, slot: int, length: int, budget: int, temp: float, top_k: int, top_p: float) -> None:
         self._active[slot] = True
@@ -794,7 +851,18 @@ class DecodeEngine:
         nothing survives, and the caller falls back to the batched bucket path.
         """
         block = self._prefix_block_size
-        while matched and matched + self.bucket_for(prompt.size - matched) > self.max_len:
+        while matched:
+            try:
+                if matched + self.bucket_for(prompt.size - matched) <= self.max_len:
+                    break
+            except ValueError:
+                # the suffix outgrew the bucket ladder while shrinking: this
+                # prompt is only admissible through its cached prefix, so the
+                # hit path cannot proceed — release and fall back (the caller
+                # raises a clean oversized-prompt error)
+                self.prefix_cache.release(path)
+                path.clear()
+                return False
             self.prefix_cache.release([path.pop()])
             matched -= block
         if not matched:
@@ -836,8 +904,10 @@ class DecodeEngine:
         is on. Runs AFTER :meth:`_activate`, on every admission path."""
         if self.prefix_cache is None:
             return
-        if self.prefix_cache_generated:
-            self._slot_tokens[slot] = [int(t) for t in prompt]
+        # the transcript serves BOTH generated-KV capture at retirement
+        # (prefix_cache_generated) and preempt-to-prefix-cache checkpointing,
+        # so it is kept whenever the cache is on (host ints: cost is trivial)
+        self._slot_tokens[slot] = [int(t) for t in prompt]
         self._extend_index(slot, prompt)
 
     def _extend_index(self, slot: int, tokens: np.ndarray) -> None:
@@ -971,6 +1041,7 @@ class DecodeEngine:
         self._partials.clear()
         self._lens_host[:] = 0
         self._remaining[:] = 0
+        self._slot_queue_wait.clear()
         self._slot_temp[:] = self.temperature
         self._slot_top_k[:] = 0
         self._slot_top_p[:] = 1.0
@@ -1005,13 +1076,19 @@ class DecodeEngine:
             or self._remaining[slot] <= 0
             or self._lens_host[slot] >= self.max_len - 1
         )
+        # the request's first decoded token carries its queue wait, so a
+        # client-side TTFT decomposes into queue vs prefill+decode time
+        queue_wait_ms = self._slot_queue_wait.pop(slot, None)
         if finished:
             self._active[slot] = False
             if self.prefix_cache is not None:
                 if self.prefix_cache_generated:
                     self._capture_generated(slot)
                 self._release_prefix(slot)
-        return StepEvent(slot=slot, token=token, emit=not is_eos, finished=finished)
+        return StepEvent(
+            slot=slot, token=token, emit=not is_eos, finished=finished,
+            queue_wait_ms=queue_wait_ms,
+        )
 
     @property
     def has_pending_events(self) -> bool:
@@ -1049,7 +1126,24 @@ class DecodeEngine:
             "ema_fetch_block_ms": None
             if self.ema_fetch_block_ms is None
             else round(self.ema_fetch_block_ms, 3),
+            "ema_queue_wait_ms": None
+            if self.ema_queue_wait_ms is None
+            else round(self.ema_queue_wait_ms, 3),
         }
+
+    def note_queue_wait(self, slot: int, wait_ms: Optional[float]) -> None:
+        """Record how long ``slot``'s request sat queued before admission (the
+        batcher calls this right after ``admit_many``). The value rides on the
+        slot's first :class:`StepEvent` and feeds the queue-wait EMA that
+        :meth:`pipeline_stats` (and ``GET /stats``) report."""
+        if wait_ms is None:
+            return
+        self._slot_queue_wait[slot] = float(wait_ms)
+        self.ema_queue_wait_ms = (
+            float(wait_ms)
+            if self.ema_queue_wait_ms is None
+            else 0.8 * self.ema_queue_wait_ms + 0.2 * float(wait_ms)
+        )
 
     def _fetch_inflight(self) -> List[StepEvent]:
         """Fetch the dispatched-but-unfetched step (no-op when none) and replay
@@ -1224,6 +1318,7 @@ class DecodeEngine:
         for slot in list(self._slot_path):
             self._release_prefix(slot)
         self._slot_tokens.clear()
+        self._slot_queue_wait.clear()
         self._remaining[:] = 0
         self._sync_slot_mirrors()
 
@@ -1250,8 +1345,83 @@ class DecodeEngine:
         self._slot_top_k[slot] = 0
         self._slot_top_p[slot] = 1.0
         self._partials.pop(slot, None)
+        self._slot_queue_wait.pop(slot, None)
         self._release_prefix(slot)
         self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
+
+    def preempt(self, slot: int) -> Optional[PreemptedSlot]:  # graftlint: off-path (scheduler policy action, not steady-state decode)
+        """Checkpoint a RUNNING slot into the prefix cache and free it.
+
+        The preempt-to-prefix-cache primitive the SLO scheduler drives: the
+        slot's full transcript (prompt + generated tokens) is indexed into the
+        radix tree block-by-block — device-copying KV only for blocks the tree
+        does not already hold — and the resulting node path is PINNED against
+        LRU eviction. The slot then deactivates exactly like :meth:`cancel`
+        (pipeline flushed first, so the transcript and the delivered token
+        stream agree), and the returned :class:`PreemptedSlot` lets the caller
+        re-queue the request: re-admitting ``tokens`` as the prompt restores
+        the pinned blocks through the ordinary prefix-hit path and pays only a
+        suffix prefill. The caller MUST eventually call
+        :meth:`release_preempted` — after the resume re-admission (which holds
+        its own references by then) or when the request is abandoned.
+
+        Returns ``None`` — leaving the slot untouched and running — when the
+        slot retired during the pipeline flush, when no transcript exists
+        (cache enabled after this slot was admitted), or when the checkpoint
+        would not be re-admissible (pool too full to capture enough blocks for
+        a transcript beyond the bucket ladder). Raises ``RuntimeError`` when
+        the prefix cache is disabled.
+        """
+        if self.prefix_cache is None:
+            raise RuntimeError("preempt requires the prefix cache (prefix_cache_blocks > 0)")
+        # flush the in-flight step under the OLD slot mapping (same rule as
+        # cancel): its tokens are real — they extend this slot's transcript
+        # and reach its consumer through the buffered events
+        self._pending_events.extend(self._fetch_inflight())
+        if not self._active[slot]:
+            return None  # retired during the flush: nothing left to preempt
+        transcript = self._slot_tokens.get(slot)
+        if transcript is None:
+            return None  # cache enabled after admission: no transcript to resume
+        valid = int(self._lens_host[slot])
+        tokens = np.asarray(transcript[:valid], dtype=np.int32)
+        # capture: index every full block of the transcript (prompt + generated),
+        # device-copying KV out of the slot's cache rows for the new ones only
+        self._extend_index(slot, tokens)
+        covered = len(self._slot_path.get(slot, ())) * self._prefix_block_size
+        try:
+            admissible = covered + self.bucket_for(valid - covered) <= self.max_len
+        except ValueError:
+            admissible = False
+        if self.prefill_chunk is not None and valid < self.max_len:
+            admissible = True  # the chunked path re-admits any in-capacity suffix
+        if not admissible:
+            # a pool too full to capture enough blocks: abandoning the slot
+            # would strand the request, so decline — it keeps running and the
+            # early-captured blocks simply age out of the tree
+            return None
+        path = self._slot_path.pop(slot, [])
+        self.prefix_cache.pin(path)  # survives LRU + the working-ref release below
+        self.prefix_cache.release(path)
+        self._slot_tokens.pop(slot, None)
+        self._active[slot] = False
+        self._reserved[slot] = False
+        self._remaining[slot] = 0
+        self._slot_temp[slot] = self.temperature
+        self._slot_top_k[slot] = 0
+        self._slot_top_p[slot] = 1.0
+        self._slot_queue_wait.pop(slot, None)
+        self.preempted_requests += 1
+        self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
+        return PreemptedSlot(tokens=[int(t) for t in tokens], path=path)
+
+    def release_preempted(self, state: PreemptedSlot) -> None:
+        """Drop a preempted checkpoint's eviction pin — after its resume
+        re-admitted (the new slot holds its own references by then) or when
+        the re-queued request was cancelled. Idempotence is the caller's job:
+        unpinning twice would free blocks a resume still depends on."""
+        if self.prefix_cache is not None and state.path:
+            self.prefix_cache.unpin(state.path)
 
     def generate(
         self,
@@ -1339,19 +1509,45 @@ class ContinuousBatcher:
         streamed tokens arrive in bursts of up to this size, and queued requests
         wait up to a burst before admission — keep it small (4-16) for
         interactive serving.
+    :param scheduler: the SLO admission-control policy
+        (:class:`~unionml_tpu.serving.scheduler.SLOScheduler`, or a
+        :class:`~unionml_tpu.serving.scheduler.SchedulerConfig` to build one).
+        Every request routes through it: bounded multi-class queueing with
+        anti-starvation aging, load shedding (structured
+        ``QueueFullError``/``DeadlineInfeasibleError``), deadline enforcement
+        on queued AND running requests, and — when the engine's prefix cache
+        is enabled — preempt-to-prefix-cache for strictly-higher-class
+        arrivals against a full house. ``None`` builds the default policy
+        (requests without ``priority``/``deadline_ms`` behave like the old
+        FIFO queue, now bounded).
     """
 
-    def __init__(self, engine: DecodeEngine, *, lookahead: int = 1) -> None:
+    def __init__(
+        self, engine: DecodeEngine, *, lookahead: int = 1, scheduler: Optional[Any] = None
+    ) -> None:
+        from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
+
         self._engine = engine
         self._lookahead = max(1, int(lookahead))
-        # guarded-by: _lock
-        self._pending: "collections.deque[Tuple[np.ndarray, int, Dict[str, Any], Any]]" = collections.deque()
+        #: the SLO admission-control queue (thread-safe: owns its own lock)
+        self.scheduler = (
+            scheduler
+            if isinstance(scheduler, SLOScheduler)
+            else SLOScheduler(scheduler if isinstance(scheduler, SchedulerConfig) else None)
+        )
         #: slot -> sink; worker-thread-only by design (admission fan-out and
         #: event dispatch both run on the worker), so no guard is declared
         self._sinks: Dict[int, Any] = {}
+        #: slot -> Ticket for the slot's current occupant (deadline enforcement
+        #: and preemption-victim choice); worker-thread-only like _sinks
+        self._slot_meta: Dict[int, Any] = {}
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._closed = False  # guarded-by: _lock
+        #: preempted checkpoints whose tickets died off-worker (close with the
+        #: worker live): the worker unpins them, keeping every prefix-cache
+        #: mutation on one thread
+        self._orphans: List[Any] = []  # guarded-by: _lock
         self._worker: Optional[threading.Thread] = None
 
     @property
@@ -1364,29 +1560,63 @@ class ContinuousBatcher:
             self._worker.start()
 
     def _submit(
-        self, prompt_ids: Sequence[int], max_new_tokens: int, sink: Any, sampling: Optional[Dict[str, Any]] = None
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        sink: Any,
+        sampling: Optional[Dict[str, Any]] = None,
+        priority: Any = None,
+        deadline_ms: Optional[float] = None,
     ) -> None:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         # surface bad requests on the caller's side, not the worker's
         if prompt.size == 0:
             raise ValueError("empty prompt")
         self._engine.bucket_for(prompt.size)
+        ticket = self.scheduler.make_ticket(
+            prompt, int(max_new_tokens), sampling, sink,
+            priority=priority, deadline_ms=deadline_ms,
+        )
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._pending.append((prompt, int(max_new_tokens), sampling or {}, sink))
+            # shed decisions raise HERE (caller side) while the close check
+            # still holds, so a shed request never reaches a closed queue
+            displaced = self.scheduler.submit(ticket)
+        if displaced is not None:
+            # a full queue displaced its worst request in favor of this one:
+            # fail it fast with the structured shed error (sink delivery is
+            # thread-safe; displaced tickets are never resumes, so no pin)
+            self._deliver(displaced.sink, "fail", displaced.shed_exc)
         self._ensure_worker()
         self._work.set()
 
     async def generate(
-        self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        priority: Any = None,
+        deadline_ms: Optional[float] = None,
+        **sampling,
     ) -> List[int]:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
-        self._submit(prompt_ids, max_new_tokens, _FutureSink(loop, future), sampling)
+        self._submit(
+            prompt_ids, max_new_tokens, _FutureSink(loop, future), sampling,
+            priority=priority, deadline_ms=deadline_ms,
+        )
         return await future
 
-    async def stream(self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling):
+    async def stream(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        priority: Any = None,
+        deadline_ms: Optional[float] = None,
+        **sampling,
+    ):
         """Async iterator of tokens, yielded as the engine decodes them.
 
         The request shares slots (and decode steps) with every other in-flight
@@ -1396,7 +1626,10 @@ class ContinuousBatcher:
         loop = asyncio.get_running_loop()
         queue: "asyncio.Queue" = asyncio.Queue()
         sink = _QueueSink(loop, queue)
-        self._submit(prompt_ids, max_new_tokens, sink, sampling)
+        self._submit(
+            prompt_ids, max_new_tokens, sink, sampling,
+            priority=priority, deadline_ms=deadline_ms,
+        )
         try:
             while True:
                 item = await queue.get()
@@ -1424,23 +1657,125 @@ class ContinuousBatcher:
             logger.warning("sink %s delivery failed (consumer gone?); dropping request", method)
             return False
 
-    def _admit(self) -> None:  # graftlint: off-path (admission, not steady-state decode)
-        while True:
-            with self._lock:
-                free = self._engine.free_slots
-                if not self._pending or not free:
+    def _release_ticket(self, ticket: Any) -> None:
+        """Drop a dead ticket's engine-side state: a preempted checkpoint's
+        eviction pin must not outlive its request (worker thread only)."""
+        if ticket.resume is not None:
+            self._engine.release_preempted(ticket.resume)
+            ticket.resume = None
+
+    def _drain_orphans(self) -> None:
+        """Unpin checkpoints whose tickets were dropped off-worker (close)."""
+        with self._lock:
+            orphans, self._orphans[:] = list(self._orphans), []
+        for state in orphans:
+            self._engine.release_preempted(state)
+
+    def _enforce_deadlines(self) -> None:  # graftlint: off-path (scheduler policy, not steady-state decode)
+        """Fail queued tickets and cancel running slots whose deadline passed.
+
+        A request that can no longer meet its SLO only burns decode steps and
+        queue positions other requests need — both sides fail fast with the
+        structured :class:`DeadlineExceededError` (HTTP 504 at the route).
+        """
+        from unionml_tpu.serving.scheduler import DeadlineExceededError
+
+        now = time.monotonic()
+        for ticket in self.scheduler.take_expired(now):
+            self._release_ticket(ticket)
+            self._deliver(
+                ticket.sink, "fail",
+                DeadlineExceededError("deadline expired while queued"),
+            )
+        for slot, ticket in list(self._slot_meta.items()):
+            if ticket.expired(now):
+                # cancel flushes the pipeline and drops this slot's own
+                # buffered tokens; survivors' events are delivered by the
+                # next step under the unchanged mapping
+                self._engine.cancel(slot)
+                self.scheduler.note_deadline_miss_running()
+                self._sinks.pop(slot, None)
+                self._slot_meta.pop(slot, None)
+                self._deliver(
+                    ticket.sink, "fail",
+                    DeadlineExceededError("deadline expired while decoding"),
+                )
+
+    def _maybe_preempt(self) -> None:  # graftlint: off-path (scheduler policy, not steady-state decode)
+        """Preempt-to-prefix-cache: when a strictly-higher-class request waits
+        with no free slot, checkpoint the worst running victim (lowest class,
+        most tokens remaining) into the prefix cache, and re-queue it so its
+        resume pays only a suffix prefill. One victim per admission round —
+        the freed slot goes to the waiter before any further preemption."""
+        if (
+            self.scheduler.config.fifo
+            or not self.scheduler.config.preempt
+            or self._engine.prefix_cache is None
+            or self._engine.free_slots
+        ):
+            return
+        waiting = self.scheduler.best_waiting_priority()
+        if waiting is None:
+            return
+        # victims: strictly lower class than the waiter, worst class first,
+        # most remaining tokens first (least sunk work per token reclaimed)
+        victims = sorted(
+            (
+                (ticket.priority, int(self._engine._remaining[slot]), slot, ticket)
+                for slot, ticket in self._slot_meta.items()
+                if ticket.priority > waiting and self._engine._active[slot]
+            ),
+            reverse=True,
+        )
+        for _, _, slot, ticket in victims:
+            state = self._engine.preempt(slot)
+            if self._engine.has_pending_events:
+                # the preempt flush ran under the OLD mapping: deliver the
+                # victim's (and survivors') flushed tokens before re-keying
+                self._dispatch_events(self._engine.take_pending_events())
+            if state is None:
+                # retired during the flush (a slot freed anyway) or not
+                # checkpointable — the dispatch above reconciled either way
+                if self._engine.free_slots:
                     return
-                batch = [self._pending.popleft() for _ in range(min(len(self._pending), len(free)))]
+                continue
+            # the sink keeps every token it already received; the ticket's
+            # prompt becomes the full transcript and its budget shrinks by
+            # the tokens already delivered, so the resumed decode continues
+            # the stream exactly where the preemption cut it
+            sink = self._sinks.pop(slot, None)
+            meta = self._slot_meta.pop(slot, ticket)
+            generated = len(state.tokens) - len(meta.prompt)
+            meta.prompt = np.asarray(state.tokens, dtype=np.int32)
+            meta.budget = int(meta.budget) - max(0, generated)
+            meta.resume = state
+            meta.sink = sink if sink is not None else meta.sink
+            self.scheduler.requeue(meta)
+            return
+
+    def _admit(self) -> None:  # graftlint: off-path (admission, not steady-state decode)
+        self._drain_orphans()
+        self._enforce_deadlines()
+        self._maybe_preempt()
+        while True:
+            free = self._engine.free_slots
+            if not free:
+                return
+            batch = self.scheduler.pop(len(free))
+            if not batch:
+                return
             admissible = []
-            for prompt, budget, sampling, sink in batch:
-                if sink.cancelled:  # consumer gave up while queued
+            for ticket in batch:
+                if ticket.sink.cancelled:  # consumer gave up while queued
+                    self._release_ticket(ticket)
                     continue
                 try:
-                    self._engine.validate_request(prompt, budget, **sampling)
+                    self._engine.validate_request(ticket.prompt, ticket.budget, **ticket.sampling)
                 except Exception as exc:  # reject this request, keep serving others
-                    self._deliver(sink, "fail", exc)
+                    self._release_ticket(ticket)
+                    self._deliver(ticket.sink, "fail", exc)
                     continue
-                admissible.append((prompt, budget, sampling, sink))
+                admissible.append(ticket)
             if not admissible:
                 continue
             resets_before = getattr(self._engine, "_resets", 0)
@@ -1448,11 +1783,12 @@ class ContinuousBatcher:
                 # one admission call: same-bucket prompts share batched prefill
                 # dispatches (⌈N/prefill_batch⌉ per bucket, not N)
                 slots = self._engine.admit_many(
-                    [(prompt, budget, sampling) for prompt, budget, sampling, _ in admissible]
+                    [(t.prompt, t.budget, t.sampling) for t in admissible]
                 )
             except Exception as exc:  # device-side failure: fail this batch, keep serving
-                for *_, sink in admissible:
-                    self._deliver(sink, "fail", exc)
+                for ticket in admissible:
+                    self._release_ticket(ticket)
+                    self._deliver(ticket.sink, "fail", exc)
                 if getattr(self._engine, "_resets", 0) != resets_before:
                     # the failure reset the engine (a pipeline flush inside
                     # admission can surface a deferred device error): every
@@ -1461,20 +1797,29 @@ class ContinuousBatcher:
                     for sink in self._sinks.values():
                         self._deliver(sink, "fail", RuntimeError(str(exc)))
                     self._sinks.clear()
+                    self._slot_meta.clear()
                 continue
             if getattr(self._engine, "has_pending_events", False):
                 # admission flushed the pipeline and may have retired previous
                 # occupants of the slots just handed out: deliver their events
                 # to the OLD sinks before the new sinks take over the mapping
                 self._dispatch_events(self._engine.take_pending_events())
-            for slot, (*_, sink) in zip(slots, admissible):
-                self._sinks[slot] = sink
+            for slot, ticket in zip(slots, admissible):
+                self._sinks[slot] = ticket.sink
+                self._slot_meta[slot] = ticket
+                self._engine.note_queue_wait(slot, ticket.queue_wait_ms)
+                if ticket.resume is not None:
+                    # the resume re-admission holds its own references on the
+                    # checkpointed blocks now: the preemption pin can go
+                    self._engine.release_preempted(ticket.resume)
+                    ticket.resume = None
 
     def _fail_all(self, exc: Exception) -> None:  # graftlint: off-path (error path)
         """Fail every in-flight request and abandon the engine's slots."""
         for sink in self._sinks.values():
             self._deliver(sink, "fail", RuntimeError(str(exc)))
         self._sinks.clear()
+        self._slot_meta.clear()
         self._engine.abort_all()
 
     def _dispatch_events(self, events) -> None:
@@ -1485,6 +1830,7 @@ class ContinuousBatcher:
                 continue
             if sink.cancelled:  # consumer abandoned the stream mid-decode
                 del self._sinks[event.slot]
+                self._slot_meta.pop(event.slot, None)
                 # a FINISHED event's slot already retired engine-side — and may
                 # even hold a newly admitted request by the time a pipeline-
                 # flushed event is delivered, so cancelling it would kill the
@@ -1497,18 +1843,22 @@ class ContinuousBatcher:
                 ok = self._deliver(sink, "emit", event.token)
             if not ok:
                 del self._sinks[event.slot]
+                self._slot_meta.pop(event.slot, None)
                 if not event.finished:
                     self._engine.cancel(event.slot)
                 continue
             if event.finished:
                 del self._sinks[event.slot]
+                self._slot_meta.pop(event.slot, None)
                 self._deliver(sink, "finish")
 
     def _run(self) -> None:  # graftlint: hot-path
         while True:
             with self._lock:
-                if self._closed and not self._pending and not self._sinks:
-                    return
+                done = self._closed and not self.scheduler.depth and not self._sinks
+            if done:
+                self._drain_orphans()
+                return
             self._admit()
             if self._engine.num_active == 0 and (
                 self._engine.has_pending_prefill
@@ -1527,9 +1877,11 @@ class ContinuousBatcher:
                 continue
             if self._engine.num_active == 0:
                 self._work.clear()
-                # re-check under the flag: a request may have landed just now
+                # re-check under the flag: a request may have landed just now.
+                # The bounded 0.5s wait doubles as the deadline-expiry tick for
+                # queued requests while the engine idles.
                 with self._lock:
-                    if self._pending or self._closed:
+                    if self.scheduler.depth or self._closed:
                         continue
                 self._work.wait(timeout=0.5)
                 continue
@@ -1537,8 +1889,7 @@ class ContinuousBatcher:
                 # full house + queued work: shorten bursts so a retiring slot is
                 # readmitted within a few steps — but not to 1, which would forfeit
                 # the whole lookahead win for the entire duration of an overload
-                with self._lock:
-                    contended = bool(self._pending) and not self._engine.free_slots
+                contended = bool(self.scheduler.depth) and not self._engine.free_slots
                 events = self._engine.step(
                     min(self._lookahead, 4) if contended else self._lookahead
                 )
@@ -1549,8 +1900,32 @@ class ContinuousBatcher:
             self._dispatch_events(events)
 
     def close(self) -> None:
+        """Shut the batcher down: every still-QUEUED request fails promptly
+        with ``RuntimeError("batcher closed")`` (futures/streams must never
+        hang on a closed batcher), running requests drain, and the worker
+        exits. Preempted checkpoints of failed tickets are unpinned on the
+        worker thread (the only prefix-cache mutator) when it is alive."""
         with self._lock:
             self._closed = True
+        orphans: List[Any] = []
+        for ticket in self.scheduler.drain():
+            if ticket.resume is not None:
+                orphans.append(ticket.resume)
+                ticket.resume = None
+            self._deliver(ticket.sink, "fail", RuntimeError("batcher closed"))
+        worker = self._worker
+        if orphans:
+            if worker is not None and worker.is_alive():
+                with self._lock:
+                    self._orphans.extend(orphans)
+            else:
+                for state in orphans:
+                    self._engine.release_preempted(state)
         self._work.set()
-        if self._worker is not None:
-            self._worker.join(timeout=5.0)
+        if worker is not None:
+            worker.join(timeout=5.0)
+            if not worker.is_alive():
+                # the worker exited without its final pass (e.g. it died on an
+                # engine failure before close): nothing else touches the cache
+                # now, so the orphaned pins can drop here
+                self._drain_orphans()

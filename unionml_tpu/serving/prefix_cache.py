@@ -76,6 +76,7 @@ class PrefixCache:
         self.hit_tokens = 0
         self.inserted_blocks = 0
         self.evicted_blocks = 0
+        self.pinned_blocks = 0
 
     @property
     def cached_blocks(self) -> int:
@@ -163,10 +164,36 @@ class PrefixCache:
         for node in path:
             node.refcount -= 1
 
+    def pin(self, path: Sequence[_Node]) -> None:
+        """Acquire an eviction-proof reference on every node of ``path``.
+
+        A PREEMPTED request's checkpoint lives only in these blocks: evicting
+        one before the resume re-admits would silently turn the resume into a
+        full re-prefill (or corrupt a partially-matched chain), so the pin
+        holds a reference across the whole queued gap — the engine's slot
+        references come and go with slots, this one belongs to the scheduler's
+        ticket. ``pinned_blocks`` (see :meth:`stats`) makes leak detection a
+        counter read: it must return to zero once every preempted request has
+        resumed or been cancelled.
+        """
+        for node in path:
+            node.refcount += 1
+        self.pinned_blocks += len(path)
+
+    def unpin(self, path: Sequence[_Node]) -> None:
+        """Drop a :meth:`pin`'s references (resume re-admitted, or the
+        preempted request was cancelled while re-queued)."""
+        for node in path:
+            node.refcount -= 1
+        # clear() may have reset the counter while paths were still pinned
+        # (engine reset drops the whole tree); never let it go negative
+        self.pinned_blocks = max(0, self.pinned_blocks - len(path))
+
     def clear(self) -> None:
         """Forget every cached block (engine reset: the pool is reallocated)."""
         self._root = _Node((), -1, None)
         self._free = list(range(self.num_blocks - 1, -1, -1))
+        self.pinned_blocks = 0
 
     def _alloc(self) -> Optional[int]:
         if self._free:
@@ -205,4 +232,5 @@ class PrefixCache:
             "hit_tokens": self.hit_tokens,
             "inserted_blocks": self.inserted_blocks,
             "evicted_blocks": self.evicted_blocks,
+            "pinned_blocks": self.pinned_blocks,
         }

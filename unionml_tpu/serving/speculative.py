@@ -19,6 +19,7 @@ tok/s at k=1 -> 32).
 
 import asyncio
 import threading
+import time
 from types import SimpleNamespace
 from typing import Any, List, Optional, Sequence
 
@@ -31,7 +32,15 @@ __all__ = ["SpeculativeBatcher"]
 
 
 class SpeculativeBatcher:
-    """Single-stream speculative generation behind the ContinuousBatcher contract."""
+    """Single-stream speculative generation behind the ContinuousBatcher contract.
+
+    Requests route through the same SLO scheduler as the continuous engine
+    (:mod:`unionml_tpu.serving.scheduler`): bounded queueing with structured
+    shedding, priority-ordered turn-taking for the single decode stream, and
+    deadline enforcement while queued — so ``GET /stats`` reports one uniform
+    scheduler counter set whichever generator backs ``/generate``. (Preemption
+    does not apply: the verify loop is batch-1 with no KV checkpoint to steal.)
+    """
 
     def __init__(
         self,
@@ -42,7 +51,10 @@ class SpeculativeBatcher:
         *,
         gamma: int = 4,
         max_len: Optional[int] = None,
+        scheduler: Optional[Any] = None,
     ) -> None:
+        from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
+
         self._target = target
         self._target_variables = target_variables
         self._draft = draft
@@ -50,6 +62,16 @@ class SpeculativeBatcher:
         self._gamma = int(gamma)
         self._max_len = int(max_len or target.config.max_position_embeddings)
         self._lock = threading.Lock()  # serializes device work across requests
+        #: SLO admission control shared-shape with ContinuousBatcher (/stats)
+        self.scheduler = (
+            scheduler
+            if isinstance(scheduler, SLOScheduler)
+            else SLOScheduler(scheduler if isinstance(scheduler, SchedulerConfig) else None)
+        )
+        #: turn-taking for the single stream: executor threads wait here until
+        #: the scheduler ranks their ticket first and no request is running
+        self._turn = threading.Condition()
+        self._current: Optional[Any] = None  # guarded-by: _turn
         self._closed = False
         # persistent evolving key (same contract as DecodeEngine): identical
         # sampled requests must NOT return identical completions unless the
@@ -93,7 +115,47 @@ class SpeculativeBatcher:
         seed = sampling.get("seed")
         return prompt, temperature, seed
 
-    def _run(self, prompt: np.ndarray, max_new_tokens: int, temperature: float, seed) -> List[int]:
+    def _await_turn(self, ticket) -> None:
+        """Block until the scheduler ranks ``ticket`` first and the stream is
+        free. Raises the ticket's shed error when a later, higher-class submit
+        displaced it, and :class:`DeadlineExceededError` when its deadline
+        passes while queued — the same structured rejections the continuous
+        path surfaces."""
+        from unionml_tpu.serving.scheduler import DeadlineExceededError
+
+        with self._turn:
+            while True:
+                if self._closed:
+                    self.scheduler.remove(ticket)
+                    raise RuntimeError("SpeculativeBatcher is closed")
+                if ticket.shed_exc is not None:  # displaced under a full queue
+                    raise ticket.shed_exc
+                if ticket.expired(time.monotonic()):
+                    # removes this ticket (and any expired peers — their own
+                    # waiting threads raise on their next poll) and counts the
+                    # queued deadline misses
+                    self.scheduler.take_expired()
+                    raise DeadlineExceededError("deadline expired while queued")
+                if self._current is None and self.scheduler.peek() is ticket:
+                    if not self.scheduler.pop_ticket(ticket):
+                        raise RuntimeError("ticket vanished from the scheduler queue")
+                    self._current = ticket
+                    return
+                self._turn.wait(timeout=0.02)
+
+    def _end_turn(self) -> None:
+        with self._turn:
+            self._current = None
+            self._turn.notify_all()
+
+    def _run(self, ticket, prompt: np.ndarray, max_new_tokens: int, temperature: float, seed) -> List[int]:
+        self._await_turn(ticket)
+        try:
+            return self._run_current(prompt, max_new_tokens, temperature, seed)
+        finally:
+            self._end_turn()
+
+    def _run_current(self, prompt: np.ndarray, max_new_tokens: int, temperature: float, seed) -> List[int]:
         from unionml_tpu.models.speculative import speculative_generate
 
         with self._lock:
@@ -126,21 +188,50 @@ class SpeculativeBatcher:
         return tokens
 
     async def generate(
-        self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        priority: Any = None,
+        deadline_ms: Optional[float] = None,
+        **sampling,
     ) -> List[int]:
         prompt, temperature, seed = self._validate(prompt_ids, max_new_tokens, sampling)
+        # admission control BEFORE any device work: shed errors (queue full /
+        # deadline infeasible) raise here, on the caller's side, exactly like
+        # the continuous path
+        ticket = self.scheduler.make_ticket(
+            prompt, int(max_new_tokens), sampling, None,
+            priority=priority, deadline_ms=deadline_ms,
+        )
+        displaced = self.scheduler.submit(ticket)
+        if displaced is not None:
+            with self._turn:  # wake the displaced ticket's waiting thread
+                self._turn.notify_all()
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            None, self._run, prompt, max_new_tokens, temperature, seed
+            None, self._run, ticket, prompt, max_new_tokens, temperature, seed
         )
 
-    async def stream(self, prompt_ids: Sequence[int], max_new_tokens: int, **sampling):
+    async def stream(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int,
+        *,
+        priority: Any = None,
+        deadline_ms: Optional[float] = None,
+        **sampling,
+    ):
         """Async iterator of tokens. Tokens arrive in one burst at completion:
         speculation verifies whole proposal rounds, so there is no per-token
         decode step to stream from (use the continuous engine for live streams)."""
-        for token in await self.generate(prompt_ids, max_new_tokens, **sampling):
+        for token in await self.generate(
+            prompt_ids, max_new_tokens, priority=priority, deadline_ms=deadline_ms, **sampling
+        ):
             yield token
 
     def close(self) -> None:
         self._closed = True
+        with self._turn:  # wake queued waiters so they fail promptly, not on poll
+            self._turn.notify_all()
         logger.info("SpeculativeBatcher closed.")
