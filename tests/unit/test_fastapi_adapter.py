@@ -122,20 +122,29 @@ def test_health_after_startup(adapter_app):
     assert asyncio.run(app.routes[("GET", "/health")]()) == {"message": "OK", "status": 200}
 
 
+def test_predict_handler_is_sync_so_fastapi_threadpools_it(adapter_app):
+    """graftlint async-blocking regression: the compiled predictor call (and
+    its device fetch) blocks for ms+, so the endpoint must be SYNC — FastAPI
+    runs sync endpoints in its threadpool instead of stalling the event loop."""
+    app, _ = adapter_app
+    handler = app.routes[("POST", "/predict")]
+    assert not asyncio.iscoroutinefunction(handler)
+
+
 def test_predict_features_path(adapter_app):
     app, _ = adapter_app
     handler = app.routes[("POST", "/predict")]
-    out = asyncio.run(handler(inputs=None, features=[{"x1": 2.0, "x2": 2.0}, {"x1": -3.0, "x2": -3.0}]))
+    out = handler(inputs=None, features=[{"x1": 2.0, "x2": 2.0}, {"x1": -3.0, "x2": -3.0}])
     assert out == [1.0, 0.0]
 
 
 def test_predict_inputs_path_and_empty_inputs(adapter_app):
     app, _ = adapter_app
     handler = app.routes[("POST", "/predict")]
-    out = asyncio.run(handler(inputs={"sample_frac": 0.1, "random_state": 1}, features=None))
+    out = handler(inputs={"sample_frac": 0.1, "random_state": 1}, features=None)
     assert len(out) == 10
     # empty {} means "run the reader with defaults" — matches the aiohttp app
-    out = asyncio.run(handler(inputs={}, features=None))
+    out = handler(inputs={}, features=None)
     assert len(out) == 100
 
 
@@ -143,7 +152,7 @@ def test_predict_requires_payload(adapter_app):
     app, _ = adapter_app
     handler = app.routes[("POST", "/predict")]
     with pytest.raises(_FakeHTTPException) as excinfo:
-        asyncio.run(handler(inputs=None, features=None))
+        handler(inputs=None, features=None)
     assert excinfo.value.status_code == 500
     assert "inputs or features" in excinfo.value.detail
 

@@ -202,14 +202,16 @@ def test_inline_suppression_applies_to_its_own_line(tmp_path):
 def test_json_report_schema(tmp_path):
     result = _lint_source(tmp_path, "schema", HOST_SYNC_REPRO)
     report = json.loads(result.report_json())
-    assert report["graftlint"] == REPORT_VERSION
+    assert report["graftlint"] == REPORT_VERSION == 2
     assert set(report) == {
-        "graftlint", "paths", "rules", "files", "counts", "findings", "suppressed",
+        "graftlint", "paths", "rules", "files", "counts",
+        "findings", "suppressed", "baselined",
     }
     assert report["files"] == 1
     assert report["counts"] == {
         "findings": len(result.findings),
         "suppressed": len(result.suppressed),
+        "baselined": 0,
     }
     for entry in report["findings"]:
         assert set(entry) == {"rule", "path", "line", "col", "message", "symbol"}
@@ -266,3 +268,474 @@ def test_cli_writes_json_report(tmp_path, capsys):
     assert report["graftlint"] == REPORT_VERSION
     assert report["counts"]["findings"] > 0
     capsys.readouterr()
+
+
+# ======================================================================
+# v2: interprocedural dataflow rule families (use-after-donate,
+# lock-order, async-blocking), suppression anchoring, baseline, SARIF
+# ======================================================================
+
+DONATE_REPRO = '''
+import jax
+
+def f(state, batch):
+    return state, 1.0
+
+step = jax.jit(f, donate_argnums=(0,))
+
+def use_after(state, batch):
+    out, loss = step(state, batch)
+    return state
+
+def loop_carried(state, batches):
+    for b in batches:
+        out, loss = step(state, b)
+    return out
+
+def disciplined(state, batches):
+    for b in batches:
+        state, loss = step(state, b)
+    return state
+
+class Engine:
+    def __init__(self):
+        self._pool = jax.numpy.zeros((4,))
+        self._save = jax.jit(f, donate_argnums=(0,))
+
+    def leak(self, batch):
+        out, loss = self._save(self._pool, batch)
+        return out
+
+    def rebind(self, batch):
+        self._pool, loss = self._save(self._pool, batch)
+'''
+
+FACTORY_DONATE_REPRO = '''
+import jax
+
+def make_step():
+    def step(state, batch):
+        return state, 1.0
+    return jax.jit(step, donate_argnums=(0,))
+
+def wrapper_factory():
+    return make_step()
+
+def caller(state, batch):
+    step = wrapper_factory()
+    out, loss = step(state, batch)
+    return state
+'''
+
+LOCK_ORDER_REPRO = '''
+import threading
+
+def fetch(x):
+    import jax
+    return jax.device_get(x)
+
+class Worker:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+        self._cv = threading.Condition()
+
+    def ab(self):
+        with self._la:
+            with self._lb:
+                return 1
+
+    def ba(self):
+        with self._lb:
+            with self._la:
+                return 2
+
+    def slow(self, fut):
+        with self._la:
+            return fut.result()
+
+    def chain(self, x):
+        with self._lb:
+            return fetch(x)
+
+    def cv_ok(self):
+        with self._cv:
+            while True:
+                self._cv.wait()
+'''
+
+ASYNC_REPRO = '''
+import asyncio
+import time
+
+import jax
+
+class Predictor:
+    def predict(self, x):
+        return jax.device_get(x)
+
+def build():
+    predictor = Predictor()
+
+    async def handler(x):
+        return predictor.predict(x)
+
+    async def ok(x):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, lambda: predictor.predict(x))
+
+    return handler, ok
+
+async def sleepy():
+    time.sleep(1)
+    return 1
+
+async def awaited_ok(q):
+    return await q.get()
+'''
+
+ALIASED_DEVICE_REPRO = '''
+import jax.numpy as jnp
+
+class Engine:
+    def __init__(self):
+        self._tokens = jnp.zeros((4,))
+        self._count = 0
+
+    def step(self):  # graftlint: hot-path
+        x = self._tokens
+        n = self._count
+        return bool(x), int(n)
+'''
+
+
+def test_use_after_donate_repro_fires(tmp_path):
+    """TP: linear read-after-donate, loop-carried donation, donated self-attr
+    never rebound. TN: the rebinding discipline in `disciplined` / `rebind`."""
+    result = _lint_source(tmp_path, "don", DONATE_REPRO)
+    assert {f.rule for f in result.findings} == {"use-after-donate"}
+    triples = {(f.rule, f.line, f.symbol) for f in result.findings}
+    assert triples == {
+        ("use-after-donate", 11, "use_after"),
+        ("use-after-donate", 15, "loop_carried"),
+        ("use-after-donate", 29, "Engine.leak"),
+    }
+    messages = {f.symbol: f.message for f in result.findings}
+    assert "loop's next iteration" in messages["loop_carried"]
+    assert "never rebound" in messages["Engine.leak"]
+
+
+def test_use_after_donate_resolves_factories_across_functions(tmp_path):
+    """`step = wrapper_factory()` donates because the factory chain ends in
+    jax.jit(..., donate_argnums=(0,)) two calls away."""
+    result = _lint_source(tmp_path, "fact", FACTORY_DONATE_REPRO)
+    assert [(f.rule, f.line, f.symbol) for f in result.findings] == [
+        ("use-after-donate", 15, "caller")
+    ]
+
+
+def test_lock_order_repro_fires(tmp_path):
+    """TP: an A->B / B->A acquisition cycle (reported at both sites), a
+    blocking .result() under a lock, and an INTERPROCEDURAL device fetch under
+    a lock. TN: unbounded Condition.wait on the HELD condition (the cv
+    protocol releases it)."""
+    result = _lint_source(tmp_path, "lk2", LOCK_ORDER_REPRO)
+    assert {f.rule for f in result.findings} == {"lock-order"}
+    triples = {(f.line, f.symbol) for f in result.findings}
+    assert triples == {
+        (16, "Worker.ab"), (21, "Worker.ba"),   # the cycle, once per edge site
+        (26, "Worker.slow"),                     # .result() under _la
+        (30, "Worker.chain"),                    # device fetch via fetch() under _lb
+    }
+    messages = "\n".join(f.message for f in result.findings)
+    assert "lock-order cycle" in messages
+    assert ".result() without a timeout" in messages
+    # the interprocedural finding names the chain down to the primitive
+    assert "fetch reaches 'jax.device_get()" in messages
+    # the cv wait is NOT flagged
+    assert not any(f.symbol == "Worker.cv_ok" for f in result.findings)
+
+
+def test_async_blocking_repro_fires(tmp_path):
+    """TP: a direct time.sleep in an async def, and an instance-type-resolved
+    chain (predictor = Predictor(); predictor.predict -> jax.device_get). TN:
+    run_in_executor lambdas and awaited calls."""
+    result = _lint_source(tmp_path, "async", ASYNC_REPRO)
+    assert {f.rule for f in result.findings} == {"async-blocking"}
+    triples = {(f.line, f.symbol) for f in result.findings}
+    assert triples == {(15, "build.handler"), (24, "sleepy")}
+    chain = next(f for f in result.findings if f.symbol == "build.handler")
+    assert "Predictor.predict" in chain.message and "jax.device_get" in chain.message
+    # the executor path and the awaited queue.get are NOT findings
+    assert not any(f.symbol in ("build.ok", "awaited_ok") for f in result.findings)
+
+
+def test_host_sync_catches_aliased_device_value_v1_provably_missed(tmp_path):
+    """The dataflow retrofit: `x = self._tokens; bool(x)` is flagged because
+    __init__ assigned self._tokens a jnp result. The regression half: no
+    identifier in the flagged expression carries the `_dev` suffix, so v1's
+    purely syntactic suffix match alone COULD NOT have flagged it."""
+    result = _lint_source(tmp_path, "alias", ALIASED_DEVICE_REPRO)
+    assert [(f.rule, f.line, f.symbol) for f in result.findings] == [
+        ("host-sync", 12, "Engine.step")
+    ]
+    finding = result.findings[0]
+    # v1's predicate: some name in the conversion arg ends with "_dev".
+    # The flagged value is the bare alias `x` — v1-invisible by construction.
+    assert "value(s) x " in finding.message
+    assert not "x".endswith("_dev")
+    # the int(n) on the host-side counter is NOT flagged (provenance, not
+    # paranoia: _count is a plain int attr)
+    assert "int" not in finding.message.split("fetches")[0]
+
+
+def test_shape_derived_locals_are_not_traced_syncs(tmp_path):
+    """`num_tokens, _ = gates.shape` then int(num_tokens * k) inside a traced
+    body is trace-time python, not a host sync (the ep.py moe pattern)."""
+    source = (
+        "import jax\nimport numpy as np\n\n"
+        "@jax.jit\n"
+        "def traced(gates, k):\n"
+        "    num_tokens, num_experts = gates.shape\n"
+        "    capacity = max(int(np.ceil(num_tokens * k / num_experts)), 1)\n"
+        "    return gates * capacity\n"
+    )
+    result = _lint_source(tmp_path, "shapes", source)
+    assert result.ok, [f.format() for f in result.findings]
+
+
+# ------------------------------------------------------- golden JSON reports
+
+
+def test_golden_reports_for_new_rule_families(tmp_path):
+    """Full machine-readable pins for the three new families: rule ids, lines,
+    columns, symbols — the report shape downstream tooling consumes."""
+    golden = {
+        "don": [
+            {"rule": "use-after-donate", "line": 11, "col": 11, "symbol": "use_after"},
+            {"rule": "use-after-donate", "line": 15, "col": 25, "symbol": "loop_carried"},
+            {"rule": "use-after-donate", "line": 29, "col": 0, "symbol": "Engine.leak"},
+        ],
+        "lk2": [
+            {"rule": "lock-order", "line": 16, "col": 0, "symbol": "Worker.ab"},
+            {"rule": "lock-order", "line": 21, "col": 0, "symbol": "Worker.ba"},
+            {"rule": "lock-order", "line": 26, "col": 19, "symbol": "Worker.slow"},
+            {"rule": "lock-order", "line": 30, "col": 19, "symbol": "Worker.chain"},
+        ],
+        "async": [
+            {"rule": "async-blocking", "line": 15, "col": 15, "symbol": "build.handler"},
+            {"rule": "async-blocking", "line": 24, "col": 4, "symbol": "sleepy"},
+        ],
+    }
+    sources = {"don": DONATE_REPRO, "lk2": LOCK_ORDER_REPRO, "async": ASYNC_REPRO}
+    for name, expected in golden.items():
+        report = _lint_source(tmp_path, name, sources[name]).report()
+        got = [
+            {k: entry[k] for k in ("rule", "line", "col", "symbol")}
+            for entry in report["findings"]
+        ]
+        assert got == expected, f"{name}: {json.dumps(got, indent=2)}"
+        assert report["counts"]["findings"] == len(expected)
+
+
+# --------------------------------------------------- suppression anchoring
+
+
+def test_suppression_on_last_line_of_multiline_statement(tmp_path):
+    """The finding sits on an inner physical line; the suppression comment on
+    the statement's closing line. Logical-line anchoring matches them."""
+    source = (
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return (\n"
+        "        x.sum()\n"
+        "        .item()\n"
+        "    )  # graftlint: disable=host-sync -- fixture: statement-level suppression\n"
+    )
+    result = _lint_source(tmp_path, "ml", source)
+    assert result.ok, [f.format() for f in result.findings]
+    assert len(result.suppressed) == 1
+    # the physical lines differ — only the anchors agree (v1 matched raw lines
+    # and provably missed this)
+    assert result.suppressed[0].line != 8
+
+
+def test_suppression_above_decorated_def_covers_the_signature(tmp_path):
+    """A standalone suppression ABOVE the decorator anchors to the decorated
+    def's logical start, covering findings on any signature line."""
+    source = (
+        "import functools\n"
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n\n"
+        "def make(devs):\n"
+        "    return Mesh(np.asarray(devs), ('data', 'tensor'))\n\n"
+        "# graftlint: disable=sharding -- fixture: decorated-def anchoring\n"
+        "@functools.lru_cache\n"
+        "def layout(\n"
+        "    mesh,\n"
+        "    spec=P('tensr'),\n"
+        "):\n"
+        "    return NamedSharding(mesh, spec)\n"
+    )
+    result = _lint_source(tmp_path, "dec", source)
+    assert result.ok, [f.format() for f in result.findings]
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].rule == "sharding"
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_silences_recorded_findings_but_not_new_ones(tmp_path):
+    from unionml_tpu.analysis import baseline_payload, load_baseline, run_lint
+
+    f = tmp_path / "legacy.py"
+    f.write_text(DONATE_REPRO)
+    first = run_lint([str(f)])
+    assert len(first.findings) == 3
+    baseline_file = tmp_path / "baseline.json"
+    baseline_file.write_text(json.dumps(baseline_payload(first.findings)))
+
+    # same tree + baseline: clean, findings inventoried as baselined
+    second = run_lint([str(f)], baseline=load_baseline(str(baseline_file)))
+    assert second.ok
+    assert len(second.baselined) == 3
+
+    # a NEW hazard is not silenced by the old inventory
+    f.write_text(DONATE_REPRO + "\n\ndef fresh(state, b):\n    o, l = step(state, b)\n    return state\n")
+    third = run_lint([str(f)], baseline=load_baseline(str(baseline_file)))
+    assert len(third.findings) == 1
+    assert third.findings[0].symbol == "fresh"
+    assert len(third.baselined) == 3
+
+
+def test_baseline_fingerprints_survive_line_moves(tmp_path):
+    """Inserting unrelated lines above must not invalidate the inventory —
+    fingerprints are line-independent."""
+    from unionml_tpu.analysis import baseline_payload, load_baseline, run_lint
+
+    f = tmp_path / "moved.py"
+    f.write_text(DONATE_REPRO)
+    payload = baseline_payload(run_lint([str(f)]).findings)
+    baseline_file = tmp_path / "baseline.json"
+    baseline_file.write_text(json.dumps(payload))
+    f.write_text("# a new header comment\nUNRELATED = 1\n" + DONATE_REPRO)
+    shifted = run_lint([str(f)], baseline=load_baseline(str(baseline_file)))
+    assert shifted.ok, [fi.format() for fi in shifted.findings]
+    assert len(shifted.baselined) == 3
+
+
+# -------------------------------------------------------------------- SARIF
+
+
+def test_sarif_output_validates_against_sarif_2_1_0_schema(tmp_path):
+    """The emitted document validates against the SARIF 2.1.0 schema
+    (structural subset of the OASIS schema, vendored next to this test)."""
+    import pathlib
+
+    jsonschema = pytest.importorskip("jsonschema")
+
+    schema = json.loads(
+        (pathlib.Path(__file__).parent / "sarif_2_1_0_schema.json").read_text()
+    )
+    for name, source in [
+        ("don", DONATE_REPRO), ("lk2", LOCK_ORDER_REPRO),
+        ("async", ASYNC_REPRO), ("sup", SUPPRESSED), ("ok", CLEAN),
+    ]:
+        doc = _lint_source(tmp_path, name, source).sarif()
+        jsonschema.validate(doc, schema)
+        assert doc["version"] == "2.1.0"
+
+
+def test_sarif_content_levels_rules_and_suppressions(tmp_path):
+    result = _lint_source(tmp_path, "sarif_don", DONATE_REPRO)
+    doc = result.sarif()
+    run = doc["runs"][0]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    # the full catalog rides along, including the always-on meta rules
+    assert {"use-after-donate", "lock-order", "async-blocking", "host-sync",
+            "suppression", "parse"} <= rules
+    results = run["results"]
+    assert len(results) == 3 and all(r["level"] == "error" for r in results)
+    for r in results:
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("sarif_don.py")
+        assert loc["region"]["startLine"] >= 1 and loc["region"]["startColumn"] >= 1
+        assert r["partialFingerprints"]["graftlint/v1"]
+    # suppressed findings carry the author's reason into the SARIF suppression
+    sup_doc = _lint_source(tmp_path, "sarif_sup", SUPPRESSED).sarif()
+    sup_results = sup_doc["runs"][0]["results"]
+    assert len(sup_results) == 1
+    assert sup_results[0]["level"] == "note"
+    assert sup_results[0]["suppressions"][0]["kind"] == "inSource"
+    assert "known-safe" in sup_results[0]["suppressions"][0]["justification"]
+
+
+def test_cli_writes_sarif_and_enforces_budget(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(RETRACE_REPRO)
+    out = tmp_path / "report.sarif"
+    assert lint_main([str(bad), "--sarif", str(out)]) == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0" and doc["runs"][0]["results"]
+    # a clean file under an absurdly tight budget fails on wall time alone
+    ok = tmp_path / "ok.py"
+    ok.write_text(CLEAN)
+    assert lint_main([str(ok), "--budget", "0.000001"]) == 1
+    assert lint_main([str(ok), "--budget", "600"]) == 0
+    captured = capsys.readouterr()
+    assert "wall" in captured.out or "wall" in captured.err
+
+
+def test_cli_baseline_roundtrip(tmp_path, capsys):
+    legacy = tmp_path / "legacy.py"
+    legacy.write_text(DONATE_REPRO)
+    baseline = tmp_path / "base.json"
+    assert lint_main([str(legacy), "--write-baseline", str(baseline)]) == 0
+    assert lint_main([str(legacy), "--baseline", str(baseline)]) == 0
+    legacy.write_text(DONATE_REPRO + "\n\ndef fresh(state, b):\n    o, l = step(state, b)\n    return state\n")
+    assert lint_main([str(legacy), "--baseline", str(baseline)]) == 1
+    capsys.readouterr()
+
+
+# ------------------------------------------- the rule catalogs stay in sync
+
+
+def test_new_rule_families_are_registered_and_listable(capsys):
+    from unionml_tpu.analysis.core import RULES, _load_rule_modules
+
+    _load_rule_modules()
+    assert {"use-after-donate", "lock-order", "async-blocking"} <= set(RULES)
+    assert lint_main(["--list-rules"]) == 0
+    listing = capsys.readouterr().out
+    for name in ("use-after-donate", "lock-order", "async-blocking"):
+        assert name in listing
+
+
+def test_mutated_engine_rebind_is_caught():
+    """Tree-grounded regression: drop ONE rebind from the REAL decode engine
+    source (the chunked-prefill cache donation) and the donation rule must
+    catch it — the discipline the serving engine depends on is mechanically
+    enforced, not reviewer folklore."""
+    import pathlib
+    import tempfile
+
+    from unionml_tpu.analysis import run_lint as _run
+
+    src = (
+        pathlib.Path(__file__).resolve().parent.parent.parent
+        / "unionml_tpu" / "serving" / "continuous.py"
+    ).read_text()
+    mutated = src.replace(
+        'logits, state["cache"] = self._chunk_fn(', 'logits, _ignored = self._chunk_fn(', 1
+    )
+    assert mutated != src, "the chunked-prefill rebind moved; update this mutation"
+    with tempfile.TemporaryDirectory() as d:
+        f = pathlib.Path(d) / "continuous.py"
+        f.write_text(mutated)
+        result = _run([str(f)], ["use-after-donate"])
+    assert any(
+        f.rule == "use-after-donate" and "state['cache']" in f.message
+        for f in result.findings
+    ), [f.format() for f in result.findings]

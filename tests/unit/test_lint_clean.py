@@ -1,44 +1,117 @@
-"""Tier-1 CI gate: the shipped tree is graftlint-finding-free.
+"""Tier-1 CI gate: the shipped tree is graftlint-finding-free — WIDENED scope.
 
-This is the whole point of the linter (ISSUE 4): the invariants PRs 1–3 each
-re-derived by hand — no host syncs on the decode hot path, no retrace churn,
-sharding specs that name real mesh axes, guarded host state written under its
-lock — are checked mechanically over the package on every run. Any new finding
-fails here; a deliberate exception needs an inline
-``# graftlint: disable=RULE -- reason`` at the site, which keeps the "why it is
-safe" in the diff where review sees it.
+This is the whole point of the linter (ISSUE 4, widened by ISSUE 6): the
+invariants PRs 1–5 each re-derived by hand — no host syncs on the decode hot
+path, no retrace churn, sharding specs that name real mesh axes, guarded host
+state written under its lock, donated buffers rebound before reuse, no lock
+cycles, no event-loop stalls — are checked mechanically over the package PLUS
+``bench_*.py`` and ``tools/`` on every run. ``tests/`` rides along behind the
+recorded baseline (``tools/graftlint_baseline.json``): its pre-existing
+findings are inventoried, only NEW ones fail. Any new finding fails here; a
+deliberate exception needs an inline ``# graftlint: disable=RULE -- reason``
+at the site, which keeps the "why it is safe" in the diff where review sees it.
 """
 
+import time
 from pathlib import Path
 
-from unionml_tpu.analysis import run_lint
+from unionml_tpu.analysis import load_baseline, run_lint
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
+#: the widened lint scope that must be finding-free (no baseline): the
+#: package, every bench entry point (baseline burned down to zero), and tools
+STRICT_PATHS = sorted(
+    [str(REPO_ROOT / "unionml_tpu"), str(REPO_ROOT / "tools")]
+    + [str(p) for p in REPO_ROOT.glob("bench*.py")]
+)
 
-def test_shipped_tree_is_finding_free():
-    result = run_lint([str(REPO_ROOT / "unionml_tpu")])
-    assert result.files > 50, "lint walked suspiciously few files — path wiring broke"
+#: whole-repo lint wall-clock budget (seconds): a linter nobody waits for is a
+#: linter that gets skipped — the CI gate prints the wall time and this test
+#: fails the run when the budget is blown
+LINT_BUDGET_S = 10.0
+
+
+def _full_scope_paths():
+    return STRICT_PATHS + [str(REPO_ROOT / "tests")]
+
+
+def test_shipped_tree_is_finding_free_across_widened_scope():
+    t0 = time.perf_counter()
+    result = run_lint(
+        _full_scope_paths(),
+        baseline=load_baseline(str(REPO_ROOT / "tools" / "graftlint_baseline.json")),
+    )
+    wall_s = time.perf_counter() - t0
+    assert result.files > 100, "lint walked suspiciously few files — path wiring broke"
     assert result.ok, "new graftlint findings:\n" + "\n".join(
         f.format() for f in result.findings
     )
+    print(f"graftlint widened-scope wall time: {wall_s:.2f}s (budget {LINT_BUDGET_S:.0f}s)")
+    assert wall_s < LINT_BUDGET_S, (
+        f"lint wall time {wall_s:.2f}s blew the {LINT_BUDGET_S:.0f}s budget — profile "
+        "the new pass before landing (interprocedural fixpoints must stay linear-ish)"
+    )
+
+
+def test_bench_scripts_are_finding_free_without_any_baseline():
+    """The bench_*.py baseline is burned down to ZERO: they lint clean
+    together with the package (cross-module donation factories resolve), with
+    no recorded-findings crutch."""
+    result = run_lint(STRICT_PATHS)
+    assert result.ok, "bench/tools findings (no baseline applies here):\n" + "\n".join(
+        f.format() for f in result.findings
+    )
+    assert not result.baselined
+
+
+def test_tests_baseline_matches_reality():
+    """The recorded tests/ inventory neither under- nor over-states: every
+    baseline entry still matches a live finding (stale entries would silently
+    grant NEW findings amnesty under occurrence counting), and the file stays
+    small — burn it down, don't grow it."""
+    baseline = load_baseline(str(REPO_ROOT / "tools" / "graftlint_baseline.json"))
+    result = run_lint(
+        _full_scope_paths(),
+        baseline=baseline,
+    )
+    assert len(result.baselined) == len(baseline), (
+        f"baseline has {len(baseline)} entries but only {len(result.baselined)} matched "
+        "live findings — regenerate tools/graftlint_baseline.json (--write-baseline) "
+        "after burning down or moving the recorded sites"
+    )
+    assert len(baseline) <= 2, "the tests/ baseline should shrink, not grow"
 
 
 def test_shipped_suppressions_all_carry_reasons():
     """Every suppression in the tree documents why the site is safe (the parse
     rejects reason-less ones as findings, so this is belt-and-braces on the
     report surface the CI gate exposes)."""
-    result = run_lint([str(REPO_ROOT / "unionml_tpu")])
+    result = run_lint(STRICT_PATHS)
     for sup in result.suppressed:
         assert sup.reason, f"reason-less suppression at {sup.path}:{sup.line}"
 
 
-def test_known_designed_sync_points_stay_suppressed_not_deleted():
-    """The two designed exceptions are load-bearing documentation: the fused
-    once-per-tick token fetch (PR-3 contract) and RetraceMonitor's intentional
-    trace-count side effect. If either suppression disappears, either the code
-    changed (update this pin) or someone deleted the annotation (restore it)."""
-    result = run_lint([str(REPO_ROOT / "unionml_tpu")])
+def test_known_designed_exceptions_stay_suppressed_not_deleted():
+    """The designed exceptions are load-bearing documentation. If one
+    disappears, either the code changed (update this pin) or someone deleted
+    the annotation (restore it):
+
+    - the fused once-per-tick token fetch (PR-3 pipelined-decode contract);
+    - RetraceMonitor's intentional trace-count side effect;
+    - TracedFunction's eager retry after a trace failure — safe ONLY because
+      _TRACE_FAILURES types raise before execution, i.e. before donation
+      consumes the args (the use-after-donate suppressions pin that argument);
+    - SpeculativeBatcher serializing device work under its lock by design;
+    - the native library's one-time g++ build under the module lock;
+    - the serving startup hooks blocking the (still traffic-free) event loop.
+    """
+    result = run_lint(STRICT_PATHS)
     where = {(s.path.split("/")[-1], s.rule) for s in result.suppressed}
     assert ("continuous.py", "host-sync") in where
     assert ("debug.py", "retrace") in where
+    assert ("stage.py", "use-after-donate") in where
+    assert ("speculative.py", "lock-order") in where
+    assert ("__init__.py", "lock-order") in where  # native/__init__.py
+    assert ("app.py", "async-blocking") in where
+    assert ("fastapi_adapter.py", "async-blocking") in where

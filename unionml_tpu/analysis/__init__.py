@@ -1,4 +1,4 @@
-"""graftlint — a JAX-aware static-analysis pass over the serving stack.
+"""graftlint — JAX-aware static analysis over the serving stack.
 
 PRs 1–3 each shipped a hand-written regression test for a whole *class* of
 bug: the transfer-guard test for host→device leaks in ``DecodeEngine.step``,
@@ -7,20 +7,34 @@ This package is the mechanical version of those reviews: an AST linter that
 checks the invariants on every CI run instead of re-discovering them one
 incident at a time.
 
-Rules (see :mod:`docs/analysis.md <docs.analysis>` for the catalog):
+v2 adds an interprocedural dataflow engine (:mod:`.dataflow`): a def-use/alias
+pass over the call graph assigning values a provenance lattice
+(host / device / traced / donated) and propagating it through assignments,
+attribute stores, and call boundaries — plus three rule families built on it.
+
+Rules (see ``docs/analysis.md`` for the catalog):
 
 - ``host-sync`` — host syncs / implicit transfers inside jit-traced bodies or
-  on ``# graftlint: hot-path`` host paths (call-graph walk).
+  on ``# graftlint: hot-path`` host paths (call-graph walk; v2 follows
+  aliases of device-resident values, not just ``_dev`` spellings).
 - ``retrace`` — jitted-callable usage that retraces or recompiles per call.
 - ``sharding`` — ``PartitionSpec`` axis names checked against the mesh axes
   the tree declares; ``NamedSharding`` built off a foreign mesh variable.
 - ``lock-discipline`` — writes to ``# guarded-by: <lock>`` host state outside
   the owning lock.
+- ``use-after-donate`` — reads of a buffer after it was passed in a
+  ``donate_argnums`` position (factories resolved cross-module).
+- ``lock-order`` — lock-acquisition cycles (potential deadlocks) and blocking
+  calls held under a lock, interprocedural.
+- ``async-blocking`` — blocking calls inside ``async def`` handlers that
+  stall the event loop.
 - ``suppression`` — always-on hygiene: every ``# graftlint: disable=`` needs a
   known rule name and a reason string.
 
 Run it as ``python -m unionml_tpu.analysis unionml_tpu/`` (exit 1 on findings)
-or programmatically via :func:`run_lint`.
+or programmatically via :func:`run_lint`. CI surfaces: ``--sarif`` (GitHub
+code scanning), ``--baseline`` (land widened scopes incrementally),
+``--budget`` (lint-runtime contract).
 """
 
 from unionml_tpu.analysis.core import (  # noqa: F401
@@ -29,7 +43,18 @@ from unionml_tpu.analysis.core import (  # noqa: F401
     LintResult,
     Project,
     RULES,
+    baseline_payload,
+    load_baseline,
     run_lint,
 )
 
-__all__ = ["Finding", "LintResult", "Project", "RULES", "REPORT_VERSION", "run_lint"]
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Project",
+    "RULES",
+    "REPORT_VERSION",
+    "baseline_payload",
+    "load_baseline",
+    "run_lint",
+]

@@ -1,27 +1,52 @@
 """graftlint CLI: ``python -m unionml_tpu.analysis [paths] [--json OUT]``.
 
-Exit codes: 0 clean, 1 findings, 2 bad invocation. Findings always fail the
-run — ``--fail-on-findings`` exists so CI scripts state the contract
-explicitly; ``--no-fail-on-findings`` turns the run advisory (report only).
+Exit codes: 0 clean, 1 findings (or blown ``--budget``), 2 bad invocation.
+Findings always fail the run — ``--fail-on-findings`` exists so CI scripts
+state the contract explicitly; ``--no-fail-on-findings`` turns the run
+advisory (report only).
+
+CI surfaces: ``--sarif OUT`` writes a SARIF 2.1.0 report (GitHub
+code-scanning upload → findings annotate PRs inline); ``--baseline FILE``
+silences findings recorded in FILE (new ones still fail) so a widened lint
+scope can land incrementally; ``--write-baseline FILE`` records the current
+findings as that inventory. ``--budget SECONDS`` enforces the lint-runtime
+contract: the wall time is always printed, and a run slower than the budget
+fails even when finding-free — a linter nobody waits for is a linter that
+gets skipped.
 """
 
 import argparse
 import sys
+import time
 
-from unionml_tpu.analysis.core import RULES, run_lint
+from unionml_tpu.analysis.core import (
+    RULES,
+    baseline_payload,
+    load_baseline,
+    run_lint,
+)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m unionml_tpu.analysis",
         description="graftlint: JAX-aware static analysis "
-                    "(host-sync, retrace, sharding, lock-discipline)",
+                    "(host-sync, retrace, sharding, lock-discipline, "
+                    "use-after-donate, lock-order, async-blocking)",
     )
     parser.add_argument("paths", nargs="*", default=["unionml_tpu"],
                         help="files or directories to lint (default: unionml_tpu)")
     parser.add_argument("--rules", help="comma-separated rule subset (default: all)")
     parser.add_argument("--json", metavar="OUT", dest="json_out",
                         help="write the machine-readable report to OUT ('-' for stdout)")
+    parser.add_argument("--sarif", metavar="OUT", dest="sarif_out",
+                        help="write a SARIF 2.1.0 report to OUT ('-' for stdout)")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="silence findings recorded in FILE (new findings still fail)")
+    parser.add_argument("--write-baseline", metavar="FILE", dest="write_baseline",
+                        help="record the current findings to FILE and exit 0")
+    parser.add_argument("--budget", type=float, metavar="SECONDS",
+                        help="fail the run when lint wall time exceeds SECONDS")
     parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
     parser.add_argument("--fail-on-findings", dest="fail", action="store_true", default=True,
                         help="exit non-zero when findings remain (default)")
@@ -30,27 +55,50 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        # import for registration side effects
-        from unionml_tpu.analysis import (  # noqa: F401
-            rules_host_sync, rules_locks, rules_retrace, rules_sharding,
-        )
+        from unionml_tpu.analysis.core import _load_rule_modules
+
+        _load_rule_modules()
         for name in sorted(RULES):
             print(f"{name:16s} {RULES[name].summary}")
         print("suppression      (always on) graftlint comments need a known rule and a reason")
         return 0
 
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as exc:
+            print(f"graftlint: cannot read baseline: {exc}", file=sys.stderr)
+            return 2
+    t0 = time.perf_counter()
     try:
-        result = run_lint(args.paths or ["unionml_tpu"], rules)
+        result = run_lint(args.paths or ["unionml_tpu"], rules, baseline=baseline)
     except ValueError as exc:
         print(f"graftlint: {exc}", file=sys.stderr)
         return 2
+    wall_s = time.perf_counter() - t0
+
+    if args.write_baseline:
+        import json as _json
+
+        with open(args.write_baseline, "w") as fh:
+            _json.dump(baseline_payload(result.findings), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(
+            f"graftlint: wrote baseline with {len(result.findings)} finding(s) "
+            f"to {args.write_baseline}"
+        )
+        return 0
 
     for finding in result.findings:
         print(finding.format())
     summary = (
         f"graftlint: {len(result.findings)} finding(s), "
-        f"{len(result.suppressed)} suppressed, {result.files} file(s)"
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined, {result.files} file(s), "
+        f"wall {wall_s:.2f}s"
+        + (f" (budget {args.budget:.0f}s)" if args.budget else "")
     )
     print(summary, file=sys.stderr if result.findings else sys.stdout)
 
@@ -61,7 +109,20 @@ def main(argv=None) -> int:
         else:
             with open(args.json_out, "w") as fh:
                 fh.write(payload)
+    if args.sarif_out:
+        payload = result.sarif_json() + "\n"
+        if args.sarif_out == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.sarif_out, "w") as fh:
+                fh.write(payload)
 
+    if args.budget is not None and wall_s > args.budget:
+        print(
+            f"graftlint: wall time {wall_s:.2f}s blew the {args.budget:.0f}s budget",
+            file=sys.stderr,
+        )
+        return 1
     return 1 if (result.findings and args.fail) else 0
 
 

@@ -15,7 +15,15 @@ Trace roots (functions whose bodies run under tracing) are discovered from:
 arguments of ``jax.jit(f, ...)`` / ``shard_map(f, ...)`` / ``pjit(f, ...)``
 calls. When a jit call's result is bound (``g = jax.jit(f)`` or
 ``self._g = jax.jit(f)``), the binding is recorded as a *jitted callable* with
-its ``static_argnums`` / ``static_argnames`` so call sites can be checked.
+its ``static_argnums`` / ``static_argnames`` / ``donate_argnums`` so call
+sites can be checked.
+
+Instance types: ``x = ClassName(...)`` (locals, lexically visible to nested
+defs) and ``self.attr = ClassName(...)`` in ``__init__`` are recorded when
+``ClassName`` is a scanned class — same module or imported from one — so
+``x.m(...)`` and ``self.attr.m(...)`` resolve to ``ClassName.m`` across
+modules. This is what lets the dataflow rules follow a lock acquisition or a
+blocking call into another module's class.
 """
 
 import ast
@@ -61,8 +69,11 @@ class FunctionInfo:
         self.class_name = class_name
         self.traced = False  # body runs under jax tracing
         self.marker: Optional[str] = None  # "hot-path" | "off-path"
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
         #: raw call sites: (callee key candidates, Call node)
         self.calls: List[Tuple[List[Tuple[str, str]], ast.Call]] = []
+        #: local name -> (module, ClassName) for ``x = ClassName(...)`` bindings
+        self.instance_types: Dict[str, Tuple[str, str]] = {}
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -77,11 +88,16 @@ class JitBinding:
 
     def __init__(self, name: str, target: Optional[FunctionInfo],
                  static_argnums: Tuple[int, ...], static_argnames: Tuple[str, ...],
-                 node: ast.Call) -> None:
+                 node: ast.Call, donate_argnums: Tuple[int, ...] = (),
+                 donate_configured: bool = False) -> None:
         self.name = name  # binding name ("g" or "self._g" normalized to "_g")
         self.target = target
         self.static_argnums = static_argnums
         self.static_argnames = static_argnames
+        #: positional args whose buffers XLA may invalidate at each call
+        self.donate_argnums = donate_argnums
+        #: donate_argnums passed but not a literal: may donate, positions unknown
+        self.donate_configured = donate_configured
         self.node = node
         #: observed literal values per static position across call sites
         self.call_sites: List[ast.Call] = []
@@ -100,6 +116,11 @@ class ModuleIndex(ast.NodeVisitor):
         self.jit_bindings: Dict[str, JitBinding] = {}
         #: string constants at module scope (axis-name vocabulary etc.)
         self.str_constants: Dict[str, str] = {}
+        #: class name -> ClassDef node (instance-type resolution)
+        self.classes: Dict[str, ast.ClassDef] = {}
+        #: class name -> {attr: (module, ClassName)} for ``self.x = Cls(...)``
+        #: bindings in ``__init__`` (cross-module method resolution)
+        self.attr_types: Dict[str, Dict[str, Tuple[str, str]]] = {}
         self._scope: List[str] = []
         self._class: List[str] = []
         self._loops = 0
@@ -154,6 +175,8 @@ class ModuleIndex(ast.NodeVisitor):
     # -------------------------------------------------------------- definitions
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if not self._scope:
+            self.classes[node.name] = node
         self._scope.append(node.name)
         self._class.append(node.name)
         self.generic_visit(node)
@@ -168,8 +191,12 @@ class ModuleIndex(ast.NodeVisitor):
             if self._is_jit_expr(dec):
                 info.traced = True
                 static_nums, static_names = self._static_info(dec)
-                self.jit_bindings[qual] = JitBinding(qual, info, static_nums, static_names,
-                                                    dec if isinstance(dec, ast.Call) else node)
+                self.jit_bindings[qual] = JitBinding(
+                    qual, info, static_nums, static_names,
+                    dec if isinstance(dec, ast.Call) else node,
+                    donate_argnums=self.donate_info(dec),
+                    donate_configured=self.donate_configured(dec),
+                )
         self._scope.append(node.name)
         self.generic_visit(node)
         self._scope.pop()
@@ -184,7 +211,53 @@ class ModuleIndex(ast.NodeVisitor):
             if isinstance(node.value, ast.Constant) and isinstance(node.value.value, str):
                 self.str_constants[node.targets[0].id] = node.value.value
         self._bind_jit_result(node)
+        self._bind_instance_type(node)
         self.generic_visit(node)
+
+    def _class_key_of(self, value: ast.AST) -> Optional[Tuple[str, str]]:
+        """(module, ClassName) when ``value`` constructs a (possibly) scanned
+        class — ``Cls(...)``, ``mod.Cls(...)``, or a conditional expression with
+        such an arm. Liberal: non-class callees simply never resolve later."""
+        if isinstance(value, ast.IfExp):
+            return self._class_key_of(value.body) or self._class_key_of(value.orelse)
+        if not isinstance(value, ast.Call):
+            return None
+        name = dotted(value.func)
+        if name is None:
+            return None
+        root, _, rest = name.partition(".")
+        if rest:  # mod.Cls(...): resolve the module alias
+            target = self.imports.get(root)
+            if target is not None:
+                return (target, rest)
+            return None
+        if name in self.classes:
+            return (self.name, name)
+        target = self.imports.get(name)
+        if target is not None and "." in target:
+            mod, _, cls = target.rpartition(".")
+            return (mod, cls)
+        return None
+
+    def _bind_instance_type(self, node: ast.Assign) -> None:
+        """Record ``x = Cls(...)`` (function locals) and ``self.a = Cls(...)``
+        (``__init__`` attrs) so method calls resolve across modules."""
+        key = self._class_key_of(node.value)
+        if key is None or len(node.targets) != 1:
+            return
+        target = node.targets[0]
+        owner = self._enclosing_function()
+        if isinstance(target, ast.Name) and owner is not None:
+            owner.instance_types[target.id] = key
+        elif (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self._class
+            and owner is not None
+            and owner.qualname.endswith("__init__")
+        ):
+            self.attr_types.setdefault(self._class[-1], {})[target.attr] = key
 
     # ------------------------------------------------------------------- loops
 
@@ -237,9 +310,35 @@ class ModuleIndex(ast.NodeVisitor):
             base = func.value
             if isinstance(base, ast.Name) and base.id == "self" and self._class:
                 out.append((self.name, f"{self._class[-1]}.{func.attr}"))
-            elif isinstance(base, ast.Name) and base.id in self.imports:
-                out.append((self.imports[base.id], func.attr))
+                # self.attr.m(...) is handled below; self.m(...) may also be an
+                # attr holding an instance of a scanned class — not expressible
+            elif isinstance(base, ast.Name):
+                key = self._instance_type_of(base.id)
+                if key is not None:
+                    out.append((key[0], f"{key[1]}.{func.attr}"))
+                if base.id in self.imports:
+                    out.append((self.imports[base.id], func.attr))
+            elif (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and self._class
+            ):
+                # self.attr.m(...): attr's class recorded from __init__
+                key = self.attr_types.get(self._class[-1], {}).get(base.attr)
+                if key is not None:
+                    out.append((key[0], f"{key[1]}.{func.attr}"))
         return out
+
+    def _instance_type_of(self, name: str) -> Optional[Tuple[str, str]]:
+        """``name``'s recorded instance class, searching the lexical chain of
+        enclosing functions innermost-first (a nested def sees its enclosing
+        function's locals)."""
+        for i in range(len(self._scope), 0, -1):
+            info = self.functions.get(".".join(self._scope[:i]))
+            if info is not None and name in info.instance_types:
+                return info.instance_types[name]
+        return None
 
     # --------------------------------------------------------------- jit plumbing
 
@@ -277,6 +376,28 @@ class ModuleIndex(ast.NodeVisitor):
                 if kw.arg == "static_argnames" and val is not None:
                     names = tuple(val) if isinstance(val, tuple) else (val,)
         return nums, names
+
+    @staticmethod
+    def donate_info(node: ast.AST) -> Tuple[int, ...]:
+        """Literal ``donate_argnums`` of a jit call expression, else ()."""
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums":
+                    val = _const(kw.value)
+                    if val is not None:
+                        return tuple(val) if isinstance(val, tuple) else (val,)
+        return ()
+
+    @staticmethod
+    def donate_configured(node: ast.AST) -> bool:
+        """True when a jit call passes ``donate_argnums=`` whose value is NOT a
+        literal (``donate_argnums=self._donate_argnums``): the callable MAY
+        donate, at positions unknowable statically."""
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums" and _const(kw.value) is None:
+                    return True
+        return False
 
     def _register_traced_arg(self, call: ast.Call) -> None:
         """Mark ``f`` traced for ``jit(f, ...)``-style calls."""
@@ -318,7 +439,11 @@ class ModuleIndex(ast.NodeVisitor):
                     fn_info = cand
                     break
         nums, names = self._static_info(call)
-        self.jit_bindings[bind_name] = JitBinding(bind_name, fn_info, nums, names, call)
+        self.jit_bindings[bind_name] = JitBinding(
+            bind_name, fn_info, nums, names, call,
+            donate_argnums=self.donate_info(call),
+            donate_configured=self.donate_configured(call),
+        )
 
 
 class CallGraph:

@@ -16,17 +16,28 @@ import time per file). Three source-comment conventions drive it:
   recovery, compile paths).
 - ``# guarded-by: <lock>`` on a ``self.x = ...`` line in ``__init__`` declares
   the attribute's owning lock for the lock-discipline rule.
+- ``# lock-order: a < b`` declares that lock ``a`` may be held while acquiring
+  lock ``b`` — a nesting the static walker cannot see (cross-thread
+  protocols); the declared edges participate in lock-order cycle detection.
+
+Suppressions anchor to LOGICAL lines: a finding anywhere inside a multi-line
+statement (or on a decorated ``def``'s signature) is silenced by a suppression
+on any physical line of that same statement, or on the standalone comment line
+above its first line.
 """
 
 import ast
 import dataclasses
+import hashlib
 import json
 import re
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
-#: JSON report schema version (bump on any shape change; pinned by tests)
-REPORT_VERSION = 1
+#: JSON report schema version (bump on any shape change; pinned by tests).
+#: v2: interprocedural rule families (use-after-donate / lock-order /
+#: async-blocking), the ``baselined`` findings list, and SARIF output.
+REPORT_VERSION = 2
 
 #: a comment is a DIRECTIVE only when the linter's name is followed by a
 #: colon; prose comments that merely mention the linter by name are not parsed
@@ -37,6 +48,9 @@ _SUPPRESS_RE = re.compile(
 )
 _MARKER_RE = re.compile(r"#\s*graftlint:\s*(hot-path|off-path)\b")
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_LOCK_ORDER_RE = re.compile(
+    r"#\s*lock-order:\s*([A-Za-z_][A-Za-z0-9_]*)\s*(?:<|->)\s*([A-Za-z_][A-Za-z0-9_]*)"
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,6 +71,22 @@ class Finding:
     def format(self) -> str:
         where = f" [{self.symbol}]" if self.symbol else ""
         return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{where}"
+
+    def fingerprint(self, occurrence: int = 0) -> str:
+        """Line-independent identity for the baseline mechanism: editing an
+        unrelated part of the file must not invalidate recorded findings, so
+        the line number stays out and embedded numbers are normalized."""
+        normalized = re.sub(r"\d+", "#", self.message)
+        # cwd-relative path: absolute and repo-relative invocations (CI runs
+        # from the repo root either way) must produce the same fingerprint
+        p = Path(self.path)
+        if p.is_absolute():
+            try:
+                p = p.relative_to(Path.cwd())
+            except ValueError:
+                pass
+        payload = "|".join([self.rule, p.as_posix(), self.symbol, normalized, str(occurrence)])
+        return hashlib.sha1(payload.encode()).hexdigest()[:20]
 
     def as_dict(self) -> Dict[str, object]:
         d = {
@@ -94,13 +124,53 @@ class SourceModule:
         self.tree = ast.parse(text, filename=str(path))
         #: code line -> Suppression
         self.suppressions: Dict[int, Suppression] = {}
+        #: logical-line start -> suppressions anchored to that statement
+        self._suppressions_by_anchor: Dict[int, List[Suppression]] = {}
         #: def line -> "hot-path" | "off-path"
         self.markers: Dict[int, str] = {}
         #: code line -> lock attribute name (from ``# guarded-by: <lock>``)
         self.guards: Dict[int, str] = {}
+        #: ``# lock-order: a < b`` hints: (line, a, b)
+        self.lock_hints: List[Tuple[int, str, str]] = []
         #: malformed-comment findings emitted by the parse (rule ``suppression``)
         self.comment_findings: List[Finding] = []
+        #: physical line -> first line of its logical statement (suppression
+        #: anchoring: a multi-line call or a decorated def is ONE logical line)
+        self._anchors: Dict[int, int] = {}
+        self._build_anchors()
+        self._code_lines = sorted(self._anchors)
         self._parse_comments()
+
+    def _build_anchors(self) -> None:
+        # ast.walk is breadth-first: parents before children, so inner
+        # statements override the span their compound parent claimed — a line
+        # anchors to its INNERMOST statement. A def's decorators and signature
+        # continuation lines anchor to the decorated-def start (no body
+        # statement claims them), which is the decorated-def anchoring rule.
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            start = min(
+                [node.lineno]
+                + [d.lineno for d in getattr(node, "decorator_list", [])]
+            )
+            end = getattr(node, "end_lineno", None) or node.lineno
+            for ln in range(start, end + 1):
+                self._anchors[ln] = start
+
+    def logical_anchor(self, line: int) -> int:
+        """First line of the logical statement containing ``line``."""
+        return self._anchors.get(line, line)
+
+    def _next_code_line(self, line: int) -> int:
+        """The first statement-covered line after ``line`` (standalone-comment
+        targets skip blank lines and further comments)."""
+        import bisect
+
+        i = bisect.bisect_right(self._code_lines, line)
+        if i < len(self._code_lines):
+            return self._code_lines[i]
+        return line + 1
 
     def _iter_comments(self):
         """(line, col, comment_text, standalone) for every REAL comment token —
@@ -119,13 +189,16 @@ class SourceModule:
 
     def _parse_comments(self) -> None:
         for line, col, comment, standalone in self._iter_comments():
-            # a standalone comment line governs the next line's code
-            target = line + 1 if standalone else line
+            # a standalone comment line governs the next code line
+            target = self._next_code_line(line) if standalone else line
             if _DIRECTIVE_RE.search(comment):
                 self._parse_graftlint_comment(line, col, comment, target)
             guarded = _GUARDED_RE.search(comment)
             if guarded:
                 self.guards[target] = guarded.group(1)
+            order = _LOCK_ORDER_RE.search(comment)
+            if order:
+                self.lock_hints.append((line, order.group(1), order.group(2)))
 
     def _parse_graftlint_comment(self, line: int, col: int, comment: str, target: int) -> None:
         marker = _MARKER_RE.search(comment)
@@ -163,12 +236,21 @@ class SourceModule:
                 )
             )
             return
-        self.suppressions[target] = Suppression(rules, reason, target)
+        sup = Suppression(rules, reason, target)
+        self.suppressions[target] = sup
+        self._suppressions_by_anchor.setdefault(self.logical_anchor(target), []).append(sup)
 
     def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
-        sup = self.suppressions.get(line)
-        if sup and (rule in sup.rules or "all" in sup.rules):
-            return sup
+        """A suppression covering ``line``: same physical line, or anchored to
+        the same logical statement (multi-line calls, decorated defs)."""
+        candidates = []
+        direct = self.suppressions.get(line)
+        if direct is not None:
+            candidates.append(direct)
+        candidates.extend(self._suppressions_by_anchor.get(self.logical_anchor(line), ()))
+        for sup in candidates:
+            if rule in sup.rules or "all" in sup.rules:
+                return sup
         return None
 
 
@@ -223,9 +305,7 @@ class Project:
     def __init__(self, paths: Sequence[str]) -> None:
         # rule modules self-register on import; comment parsing validates
         # disable= names against the registry, so load them first
-        from unionml_tpu.analysis import (  # noqa: F401
-            rules_host_sync, rules_locks, rules_retrace, rules_sharding,
-        )
+        _load_rule_modules()
 
         self.paths = list(paths)
         self.modules: List[SourceModule] = []
@@ -250,13 +330,75 @@ class Project:
         return self._by_name.get(name)
 
 
-def run_lint(paths: Sequence[str], rules: Optional[Sequence[str]] = None) -> "LintResult":
-    """Lint ``paths`` with the selected (default: all) rules."""
+def _load_rule_modules() -> None:
+    """Import every rule module for its registration side effect."""
+    from unionml_tpu.analysis import (  # noqa: F401
+        rules_async,
+        rules_deadlock,
+        rules_donation,
+        rules_host_sync,
+        rules_locks,
+        rules_retrace,
+        rules_sharding,
+    )
+
+
+def load_baseline(path: str) -> Dict[str, Dict[str, object]]:
+    """Read a ``--baseline`` file: fingerprint -> recorded finding summary."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path} is not a graftlint baseline file")
+    return dict(data["findings"])
+
+
+def baseline_payload(findings: Sequence["Finding"]) -> Dict[str, object]:
+    """The ``--write-baseline`` file body for the given active findings."""
+    recorded: Dict[str, Dict[str, object]] = {}
+    counts: Dict[Tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.rule, f.path, f.symbol)
+        occurrence = counts.get(key, 0)
+        counts[key] = occurrence + 1
+        recorded[f.fingerprint(occurrence)] = {
+            "rule": f.rule, "path": f.path, "symbol": f.symbol, "message": f.message,
+        }
+    return {"graftlint_baseline": 1, "findings": recorded}
+
+
+def _split_baselined(
+    findings: List["Finding"], baseline: Dict[str, Dict[str, object]]
+) -> Tuple[List["Finding"], List["Finding"]]:
+    """Partition findings into (new, baselined) by line-independent
+    fingerprint; occurrence counting keeps N recorded duplicates silencing at
+    most N live ones."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    counts: Dict[Tuple, int] = {}
+    for f in findings:
+        key = (f.rule, f.path, f.symbol)
+        occurrence = counts.get(key, 0)
+        counts[key] = occurrence + 1
+        (old if f.fingerprint(occurrence) in baseline else new).append(f)
+    return new, old
+
+
+def run_lint(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    *,
+    baseline: Optional[Dict[str, Dict[str, object]]] = None,
+) -> "LintResult":
+    """Lint ``paths`` with the selected (default: all) rules.
+
+    ``baseline`` (see :func:`load_baseline`) moves findings whose fingerprint
+    is recorded into ``result.baselined`` — reported, but not failing — so a
+    widened scope can land with its pre-existing findings inventoried and only
+    NEW ones breaking the build.
+    """
     # rule modules self-register on import (Project also does this, but rule
     # selection below needs the registry before any Project exists)
-    from unionml_tpu.analysis import (  # noqa: F401
-        rules_host_sync, rules_locks, rules_retrace, rules_sharding,
-    )
+    _load_rule_modules()
 
     selected = list(rules) if rules else sorted(RULES)
     unknown = [r for r in selected if r not in RULES]
@@ -279,8 +421,11 @@ def run_lint(paths: Sequence[str], rules: Optional[Sequence[str]] = None) -> "Li
                 active.append(finding)
     active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    baselined: List[Finding] = []
+    if baseline:
+        active, baselined = _split_baselined(active, baseline)
     return LintResult(paths=list(paths), rules=selected, files=len(project.modules),
-                      findings=active, suppressed=suppressed)
+                      findings=active, suppressed=suppressed, baselined=baselined)
 
 
 @dataclasses.dataclass
@@ -292,6 +437,9 @@ class LintResult:
     files: int
     findings: List[Finding]
     suppressed: List[Finding]
+    #: pre-existing findings recorded in a ``--baseline`` file: reported, not
+    #: failing (``ok`` ignores them) — the widened-scope landing mechanism
+    baselined: List[Finding] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -303,10 +451,24 @@ class LintResult:
             "paths": self.paths,
             "rules": self.rules,
             "files": self.files,
-            "counts": {"findings": len(self.findings), "suppressed": len(self.suppressed)},
+            "counts": {
+                "findings": len(self.findings),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+            },
             "findings": [f.as_dict() for f in self.findings],
             "suppressed": [f.as_dict() for f in self.suppressed],
+            "baselined": [f.as_dict() for f in self.baselined],
         }
 
     def report_json(self) -> str:
         return json.dumps(self.report(), indent=2)
+
+    def sarif(self) -> Dict[str, object]:
+        """The SARIF 2.1.0 document (GitHub code scanning compatible)."""
+        from unionml_tpu.analysis.sarif import to_sarif
+
+        return to_sarif(self)
+
+    def sarif_json(self) -> str:
+        return json.dumps(self.sarif(), indent=2)

@@ -1,0 +1,605 @@
+"""Interprocedural dataflow for graftlint v2: provenance, donation, blocking.
+
+The v1 rules were syntactic and per-statement: a donated buffer read through an
+alias, a lock acquired three calls below another lock's ``with`` block, or a
+device value renamed before its ``bool()`` all sailed through. This module is
+the shared machinery the v2 rule families build on:
+
+- **Provenance lattice.** Every tracked value sits in a small lattice::
+
+        unknown  (top: nothing provable — rules stay silent)
+        host     (numpy / python scalars / fetched values)
+        device   (jnp/jax call results, ``*_dev`` mirrors, known device attrs)
+        traced   (values inside a jit-traced body — owned by rules_host_sync)
+        donated  (passed in a ``donate_argnums`` position; the buffer is dead)
+
+  ``donated`` and ``traced`` are *taints* layered over host/device; joins go to
+  ``unknown`` — the analysis is deliberately best-effort, and an unprovable
+  provenance produces silence, never a guess. The practical consequences:
+  aliasing is tracked through plain assignments and attribute loads only;
+  values that round-trip containers, comprehensions, or unscanned callees
+  drop to ``unknown``.
+
+- **Donation environment** (:class:`DonationEnv`): which callables donate
+  which positional args. Sources: direct jit bindings with ``donate_argnums``
+  (``self._save_fn = jax.jit(_save, donate_argnums=(0,))``), decorator forms,
+  and **factories** — functions whose returns are donating jit callables
+  (``make_classifier_train_step`` -> ``_wrap_step`` -> ``jax.jit(step,
+  donate_argnums=(0,))``), resolved cross-module through the call graph with a
+  fixpoint, so ``step = make_lm_train_step(...)``'s call sites are checked in
+  bench scripts too.
+
+- **Blocking summaries** (:class:`Summaries`): per-function "does calling this
+  stall the calling thread" — direct primitives (``time.sleep``, unbounded
+  ``.wait()``/``.join()``/``.result()``/``.acquire()``, ``subprocess.run``,
+  ``jax.device_get``, ``.block_until_ready()``) propagated up resolved call
+  edges to a fixpoint, with the call chain kept for the finding message.
+
+- **Lock model** (:class:`LockModel`): lock identities ((module, class, attr)
+  for ``self._lock = threading.Lock()`` in ``__init__``, (module, None, name)
+  for module-level locks) and per-function acquisition summaries, again
+  propagated interprocedurally so ``with self._lock: self.scheduler.submit()``
+  yields the cross-class edge ``batcher._lock -> scheduler._lock``.
+
+- **Device aliasing** (:func:`device_locals` / :func:`device_attrs`): the
+  host-sync retrofit — ``x = self._tokens`` followed by ``bool(x)`` is caught
+  because ``self._tokens`` was assigned a ``jnp`` result in ``__init__`` and
+  the local ``x`` inherits its provenance.
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from unionml_tpu.analysis.callgraph import CallGraph, FunctionInfo, ModuleIndex, dotted
+
+#: (module, class-or-None, attribute/name) — one lock's identity
+LockKey = Tuple[str, Optional[str], str]
+
+#: threading constructors that create a mutual-exclusion (``with``-able) lock
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+#: subprocess entry points that wait for the child
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output", "communicate"}
+
+
+def own_nodes(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root`` without descending into nested function/class bodies or
+    lambdas — the nodes that execute as part of *this* function's frame."""
+    stack: List[ast.AST] = [root]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        first = False
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _call_map(fn: FunctionInfo) -> Dict[int, List[Tuple[str, str]]]:
+    """id(Call node) -> callee candidates, cached per function (rules resolve
+    individual sites; the list scan would be quadratic)."""
+    cache = getattr(fn, "_graftlint_call_map", None)
+    if cache is None:
+        cache = {id(node): cands for cands, node in fn.calls}
+        fn._graftlint_call_map = cache
+    return cache
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """True when the call passes any positional arg or a ``timeout=`` kwarg —
+    bounded waits are stalls, not deadlocks, and stay out of scope."""
+    return bool(call.args) or any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def blocking_reason(call: ast.Call, idx: ModuleIndex) -> Optional[str]:
+    """Why this call blocks the current thread indefinitely (None if it
+    doesn't, or if we cannot prove it does)."""
+    name = dotted(call.func)
+    if name is not None:
+        root, _, rest = name.partition(".")
+        expanded = idx.imports.get(root, root) + (("." + rest) if rest else "")
+        if expanded == "time.sleep":
+            return "time.sleep() sleeps the thread"
+        if expanded in ("jax.device_get",):
+            return "jax.device_get() blocks on the device stream"
+        leaf = expanded.rsplit(".", 1)[-1]
+        if expanded.startswith("subprocess.") and leaf in _SUBPROCESS_BLOCKING:
+            return f"subprocess.{leaf}() waits for the child process"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr == "block_until_ready":
+            return ".block_until_ready() blocks on the device stream"
+        if attr == "result" and not call.args and not call.keywords:
+            return ".result() without a timeout blocks until the future resolves"
+        if attr == "join" and not _has_timeout(call):
+            # str.join always takes an iterable argument, so a zero-arg join is
+            # a thread/process join
+            return ".join() without a timeout blocks until the worker exits"
+        if attr == "wait" and not _has_timeout(call):
+            return ".wait() without a timeout blocks unboundedly"
+        if attr == "acquire" and not _has_timeout(call):
+            if not any(
+                isinstance(kw.value, ast.Constant) and kw.value.value is False
+                for kw in call.keywords
+                if kw.arg == "blocking"
+            ):
+                return ".acquire() without a timeout blocks until the lock frees"
+    return None
+
+
+def _wait_receiver(call: ast.Call) -> Optional[ast.AST]:
+    """The receiver of a ``.wait()`` call (condition-variable exemption)."""
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "wait":
+        return call.func.value
+    return None
+
+
+# --------------------------------------------------------------------- locks
+
+
+class LockModel:
+    """Every lock the tree declares, plus helpers to name an acquisition."""
+
+    def __init__(self, graph: CallGraph) -> None:
+        self.locks: Set[LockKey] = set()
+        #: parsed ``# lock-order: a < b`` hints: (module, line, a, b)
+        self.hints: List[Tuple[str, int, str, str]] = []
+        for idx in graph.indexes:
+            self._collect_module(idx)
+
+    def _collect_module(self, idx: ModuleIndex) -> None:
+        for node in idx.source.tree.body:
+            if isinstance(node, ast.Assign) and self._is_lock_ctor(node.value, idx):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.locks.add((idx.name, None, t.id))
+        for cls_name, cls_node in idx.classes.items():
+            for sub in ast.walk(cls_node):
+                if isinstance(sub, ast.Assign) and self._is_lock_ctor(sub.value, idx):
+                    for t in sub.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            self.locks.add((idx.name, cls_name, t.attr))
+
+    @staticmethod
+    def _is_lock_ctor(value: ast.AST, idx: ModuleIndex) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        name = dotted(value.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf not in _LOCK_CTORS:
+            return False
+        root = name.split(".", 1)[0]
+        target = idx.imports.get(root, root)
+        # threading.Lock() / Lock() (from threading import Lock) /
+        # multiprocessing.Lock(); a same-named user class would need the
+        # ``# lock-order:`` hint instead
+        return leaf == root or target in ("threading", "multiprocessing")
+
+    def lock_of(self, expr: ast.AST, idx: ModuleIndex, cls: Optional[str]) -> Optional[LockKey]:
+        """The lock an acquisition expression names, or None."""
+        if isinstance(expr, ast.Call):  # with self._lock.acquire_timeout(...)
+            expr = expr.func
+            if isinstance(expr, ast.Attribute):
+                expr = expr.value
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            key = (idx.name, cls, expr.attr)
+            return key if key in self.locks else None
+        if isinstance(expr, ast.Name):
+            key = (idx.name, None, expr.id)
+            return key if key in self.locks else None
+        return None
+
+    def by_attr(self, module: str, attr: str) -> List[LockKey]:
+        """Locks in ``module`` whose attribute/name is ``attr`` (hint lookup)."""
+        return [k for k in self.locks if k[0] == module and k[2] == attr]
+
+
+# ----------------------------------------------------------------- summaries
+
+
+class BlockInfo:
+    """Why a function blocks: the primitive's reason plus the call chain."""
+
+    def __init__(self, reason: str, line: int, chain: Tuple[str, ...]) -> None:
+        self.reason = reason
+        self.line = line  # line of the primitive in ITS function
+        self.chain = chain  # qualnames from this function down to the primitive
+
+    def via(self, qualname: str) -> "BlockInfo":
+        return BlockInfo(self.reason, self.line, (qualname,) + self.chain)
+
+
+class Summaries:
+    """Per-function interprocedural facts: blocking, lock acquisition.
+
+    Both are least-fixpoints over resolved call edges; unresolvable calls
+    contribute nothing (best-effort: silence over noise).
+    """
+
+    def __init__(self, graph: CallGraph, locks: LockModel) -> None:
+        self.graph = graph
+        self.locks = locks
+        self.blocking: Dict[Tuple[str, str], BlockInfo] = {}
+        self.acquires: Dict[Tuple[str, str], Set[LockKey]] = {}
+        self._compute_direct()
+        self._fixpoint()
+
+    def _compute_direct(self) -> None:
+        for idx in self.graph.indexes:
+            for fn in idx.functions.values():
+                acquired: Set[LockKey] = set()
+                for node in own_nodes(fn.node):
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        for item in node.items:
+                            key = self.locks.lock_of(item.context_expr, idx, fn.class_name)
+                            if key is not None:
+                                acquired.add(key)
+                    elif isinstance(node, ast.Call) and fn.key not in self.blocking:
+                        reason = blocking_reason(node, idx)
+                        if reason is not None and not self._is_condition_wait(node, idx, fn):
+                            self.blocking[fn.key] = BlockInfo(
+                                reason, node.lineno, (fn.qualname,)
+                            )
+                if acquired:
+                    self.acquires[fn.key] = acquired
+
+    def _is_condition_wait(self, call: ast.Call, idx: ModuleIndex, fn: FunctionInfo) -> bool:
+        """``cond.wait()`` where ``cond`` is a declared lock: the wait RELEASES
+        the lock while parked (the condition-variable protocol), so it is not
+        a blocking primitive for the under-lock rule; the surrounding loop's
+        progress is the scheduler's business, not the linter's."""
+        recv = _wait_receiver(call)
+        return recv is not None and self.locks.lock_of(recv, idx, fn.class_name) is not None
+
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for idx in self.graph.indexes:
+                for fn in idx.functions.values():
+                    for candidates, call in fn.calls:
+                        callee = self.graph._resolve(candidates)
+                        if callee is None or callee.key == fn.key:
+                            continue
+                        info = self.blocking.get(callee.key)
+                        if info is not None and fn.key not in self.blocking:
+                            if len(info.chain) < 6:  # chains longer than this are noise
+                                self.blocking[fn.key] = info.via(fn.qualname)
+                                changed = True
+                        callee_locks = self.acquires.get(callee.key)
+                        if callee_locks:
+                            mine = self.acquires.setdefault(fn.key, set())
+                            if not callee_locks <= mine:
+                                mine |= callee_locks
+                                changed = True
+
+    def resolve_call(self, fn: FunctionInfo, call: ast.Call) -> Optional[FunctionInfo]:
+        """The scanned callee of one recorded call site of ``fn`` (None when
+        unresolved or not this exact node)."""
+        candidates = _call_map(fn).get(id(call))
+        return self.graph._resolve(candidates) if candidates else None
+
+
+# ------------------------------------------------------------------ donation
+
+
+#: sentinel position: "may donate, positions configured at runtime" — e.g.
+#: ``jax.jit(fn, donate_argnums=self._donate_argnums)``. Only *args splats can
+#: be tainted under it (the tuple whose elements may have been donated).
+CONFIGURED_DONATION = (-1,)
+
+
+class DonationEnv:
+    """Which callables donate which positional arguments.
+
+    ``factory_positions`` maps scanned functions that RETURN a donating
+    compiled callable to its donate positions (fixpoint: a factory may return
+    another factory's result — ``make_lm_train_step`` -> ``_wrap_step``).
+    """
+
+    def __init__(self, graph: CallGraph) -> None:
+        self.graph = graph
+        self.factory_positions: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+        #: (module, class, attr) -> positions, for ``self._f = factory_fn``
+        self.attr_factories: Dict[Tuple[str, str, str], Tuple[int, ...]] = {}
+        self._compute_factories()
+        self._compute_attr_factories()
+
+    def _compute_factories(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for idx in self.graph.indexes:
+                for fn in idx.functions.values():
+                    if fn.key in self.factory_positions:
+                        continue
+                    pos = self._returned_donation(fn, idx)
+                    if pos:
+                        self.factory_positions[fn.key] = pos
+                        changed = True
+
+    def _returned_donation(self, fn: FunctionInfo, idx: ModuleIndex) -> Tuple[int, ...]:
+        for node in own_nodes(fn.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            value = node.value
+            if isinstance(value, ast.Call):
+                donate = ModuleIndex.donate_info(value)
+                if donate:
+                    return donate
+                if ModuleIndex.donate_configured(value):
+                    return CONFIGURED_DONATION
+                # return another_factory(...): inherit its positions
+                callee = self._resolve_value_call(value, idx, fn)
+                if callee is not None and callee.key in self.factory_positions:
+                    return self.factory_positions[callee.key]
+            elif isinstance(value, ast.Name):
+                # return jitted  — where ``jitted = jax.jit(..., donate_...)``
+                binding = idx.jit_bindings.get(value.id)
+                if binding is not None and binding.donate_argnums:
+                    return binding.donate_argnums
+                if binding is not None and binding.donate_configured:
+                    return CONFIGURED_DONATION
+        return ()
+
+    def _resolve_value_call(
+        self, call: ast.Call, idx: ModuleIndex, fn: FunctionInfo
+    ) -> Optional[FunctionInfo]:
+        candidates = _call_map(fn).get(id(call))
+        return self.graph._resolve(candidates) if candidates else None
+
+    def _compute_attr_factories(self) -> None:
+        """``self._make_step = _make_step`` in ``__init__``-like methods binds
+        a factory to an attribute; later ``self._make_step(...)`` calls build
+        donating callables."""
+        for idx in self.graph.indexes:
+            for fn in idx.functions.values():
+                if fn.class_name is None:
+                    continue
+                for node in own_nodes(fn.node):
+                    if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Name):
+                        continue
+                    # the factory must be lexically resolvable from here
+                    for i in range(fn.qualname.count(".") + 1, -1, -1):
+                        parts = fn.qualname.split(".")[:i] + [node.value.id]
+                        cand = idx.functions.get(".".join(parts))
+                        if cand is not None and cand.key in self.factory_positions:
+                            for t in node.targets:
+                                if (
+                                    isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"
+                                ):
+                                    self.attr_factories[
+                                        (idx.name, fn.class_name, t.attr)
+                                    ] = self.factory_positions[cand.key]
+                            break
+
+    def donating_positions(
+        self,
+        call: ast.Call,
+        idx: ModuleIndex,
+        fn: FunctionInfo,
+        local_factories: Dict[str, Tuple[int, ...]],
+    ) -> Tuple[Tuple[int, ...], str]:
+        """(positions, callee label) when ``call`` invokes a donating callable;
+        ``((), "")`` otherwise. ``local_factories`` carries names the caller's
+        linear walk bound to factory-call results (``step = make_step(...)``).
+        """
+        func = call.func
+        # direct double call: make_lm_train_step(...)(state, batch)
+        if isinstance(func, ast.Call):
+            donate = ModuleIndex.donate_info(func)
+            if donate:
+                return donate, "jax.jit(...)"
+            callee = self._resolve_value_call(func, idx, fn)
+            if callee is not None and callee.key in self.factory_positions:
+                return self.factory_positions[callee.key], callee.qualname
+        name = dotted(func)
+        if name is None:
+            return (), ""
+        leaf = name.rsplit(".", 1)[-1]
+        if name in local_factories:
+            return local_factories[name], name
+        binding = idx.jit_bindings.get(leaf)
+        if binding is not None and binding.donate_argnums:
+            return binding.donate_argnums, leaf
+        if binding is not None and binding.donate_configured:
+            return CONFIGURED_DONATION, leaf
+        return (), ""
+
+    def factory_call_positions(
+        self, call: ast.Call, idx: ModuleIndex, fn: FunctionInfo
+    ) -> Tuple[int, ...]:
+        """Positions when ``call`` invokes a FACTORY (its result is a donating
+        callable) — for tracking ``step = make_classifier_train_step(...)``."""
+        func = call.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and fn.class_name is not None
+        ):
+            key = (idx.name, fn.class_name, func.attr)
+            if key in self.attr_factories:
+                return self.attr_factories[key]
+        callee = self._resolve_value_call(call, idx, fn)
+        if callee is not None and callee.key in self.factory_positions:
+            return self.factory_positions[callee.key]
+        return ()
+
+
+def donated_arg_exprs(call: ast.Call, positions: Sequence[int]) -> List[Tuple[str, ast.AST]]:
+    """(normalized source, node) of each donated argument that names a
+    REUSABLE value (Name/Attribute/Subscript); fresh temporaries (call results,
+    literals) have nothing to use after the donation and are skipped.
+
+    Positions at or past a ``*args`` splat — and every position under
+    :data:`CONFIGURED_DONATION` — cannot be pinned to one argument, so the
+    SPLAT NAME itself is tainted instead: the tuple may hold donated buffers,
+    and forwarding it again (``self._fn(*args)`` retry patterns) reuses them.
+    """
+    out: List[Tuple[str, ast.AST]] = []
+    star_at = next(
+        (i for i, a in enumerate(call.args) if isinstance(a, ast.Starred)), len(call.args)
+    )
+
+    def taint_splats() -> None:
+        for a in call.args:
+            if isinstance(a, ast.Starred) and isinstance(a.value, ast.Name):
+                out.append((a.value.id, a.value))
+
+    if tuple(positions) == CONFIGURED_DONATION:
+        taint_splats()
+        return out
+    for p in positions:
+        if p >= min(star_at, len(call.args)):
+            if p >= star_at:
+                taint_splats()
+            continue
+        arg = call.args[p]
+        if isinstance(arg, (ast.Name, ast.Attribute, ast.Subscript)):
+            try:
+                out.append((ast.unparse(arg), arg))
+            except Exception:  # pragma: no cover - unparse is total on these
+                continue
+    return out
+
+
+def shared_analyses(graph: CallGraph) -> Tuple[LockModel, "Summaries"]:
+    """One (LockModel, Summaries) pair per call graph — the lock-order and
+    async-blocking rules share the fixpoint instead of recomputing it."""
+    cached = getattr(graph, "_graftlint_analyses", None)
+    if cached is None:
+        locks = LockModel(graph)
+        cached = (locks, Summaries(graph, locks))
+        graph._graftlint_analyses = cached
+    return cached
+
+
+# ------------------------------------------------------------ device aliasing
+
+
+def _expr_is_device(node: ast.AST, idx: ModuleIndex, dev_attrs: Set[str],
+                    dev_locals: Set[str]) -> bool:
+    """Best-effort: does this expression yield a device-resident value?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id.endswith("_dev") or sub.id in dev_locals:
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr.endswith("_dev"):
+                return True
+            if (
+                isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and sub.attr in dev_attrs
+            ):
+                return True
+        elif isinstance(sub, ast.Call):
+            name = dotted(sub.func) or ""
+            root = name.split(".", 1)[0]
+            target = idx.imports.get(root, root)
+            if target in ("jax.numpy", "jax") or target.startswith("jax.numpy"):
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf not in ("device_get",):  # fetches produce HOST values
+                    return True
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in idx.jit_bindings:
+                return True
+    return False
+
+
+def device_attrs(idx: ModuleIndex, cls_name: str) -> Set[str]:
+    """Attributes of ``cls_name`` assigned device-provenance values anywhere in
+    the class body (``self._tokens = jnp.zeros(...)`` in ``__init__`` makes
+    ``self._tokens`` device-resident for every method)."""
+    cls = idx.classes.get(cls_name)
+    if cls is None:
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _expr_is_device(node.value, idx, out, set()):
+            continue
+        for t in node.targets:
+            targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for el in targets:
+                if (
+                    isinstance(el, ast.Attribute)
+                    and isinstance(el.value, ast.Name)
+                    and el.value.id == "self"
+                    and not el.attr.endswith("_host")
+                ):
+                    out.add(el.attr)
+    return out
+
+
+def _mentions_shape(node: ast.AST, shape_names: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) and sub.func.id == "len":
+            return True
+        if isinstance(sub, ast.Name) and sub.id in shape_names:
+            return True
+    return False
+
+
+def shape_locals(fn: FunctionInfo) -> Set[str]:
+    """Local names carrying trace-time shape arithmetic: assigned from
+    expressions mentioning ``.shape``/``.ndim``/``.size``/``len()`` or other
+    shape locals (``num_tokens, num_experts = gates.shape``). Conversions of
+    these are python ints at trace time, never host syncs."""
+    out: Set[str] = set()
+    for _ in range(3):
+        before = len(out)
+        for node in own_nodes(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _mentions_shape(node.value, out):
+                continue
+            for t in node.targets:
+                targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for el in targets:
+                    if isinstance(el, ast.Name):
+                        out.add(el.id)
+        if len(out) == before:
+            break
+    return out
+
+
+def device_locals(fn: FunctionInfo, idx: ModuleIndex) -> Set[str]:
+    """Local names aliasing device values in ``fn`` — one forward pass over
+    its own assignments (``x = self._tokens``; ``y = x`` chains converge in at
+    most a couple of iterations)."""
+    dev_attrs = device_attrs(idx, fn.class_name) if fn.class_name else set()
+    out: Set[str] = set()
+    for _ in range(3):  # alias chains are short; bounded fixpoint
+        before = len(out)
+        for node in own_nodes(fn.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _expr_is_device(node.value, idx, dev_attrs, out):
+                continue
+            for t in node.targets:
+                targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for el in targets:
+                    if isinstance(el, ast.Name):
+                        out.add(el.id)
+        if len(out) == before:
+            break
+    return out

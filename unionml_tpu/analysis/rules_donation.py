@@ -1,0 +1,255 @@
+"""Rule ``use-after-donate``: reading a buffer after XLA was told to reuse it.
+
+``donate_argnums`` hands an argument's HBM to the compiled program: after the
+call, the caller-side array is **deleted** — any later read raises (jax checks)
+or, under some paths, silently reads freed memory. The serving engine leans on
+donation everywhere (the decode step donates the KV cache and logits, block
+saves donate the pool, training steps donate the optimizer state), so the
+discipline "every donated input is rebound from the call's outputs, in the
+same statement" is load-bearing. This rule checks it with the dataflow layer's
+donation environment (:class:`~unionml_tpu.analysis.dataflow.DonationEnv`):
+
+- **use-after-donate** — a donated Name/Attribute/Subscript expression is read
+  again before being rebound. Aliases die with the source: only rebinding the
+  exact expression (or its base name) clears the taint.
+- **loop-carried donation** — the donating call sits in a loop and the donated
+  expression is not rebound in the loop body: iteration N+1 reads the buffer
+  iteration N donated (``for b in batches: step(state, b)`` — the classic).
+  Detected by replaying the loop body once with the surviving taints.
+- **donated attribute never rebound** — a donated ``self.X`` that is not
+  reassigned anywhere later in the method outlives the frame on the instance;
+  any OTHER method's read then sees a deleted buffer. Flagged at the donation
+  site (cross-method read ordering is beyond static reach; the rebind is not).
+
+Factories are resolved interprocedurally: ``step = make_lm_train_step(...)``
+marks ``step`` donating-at-position-0 because the factory's returns chain to
+``jax.jit(train_step, donate_argnums=(0,))`` through ``_wrap_step``.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from unionml_tpu.analysis.callgraph import FunctionInfo, ModuleIndex
+from unionml_tpu.analysis.core import Finding, Project, register
+from unionml_tpu.analysis.dataflow import DonationEnv, donated_arg_exprs
+
+
+@dataclasses.dataclass
+class _Taint:
+    expr: str
+    line: int  # donation site
+    callee: str
+    loop_pass: bool = False  # created during a loop replay pass
+
+
+class _FunctionWalk:
+    """One function's linear donation walk (statements in program order)."""
+
+    def __init__(self, fn: FunctionInfo, idx: ModuleIndex, env: DonationEnv) -> None:
+        self.fn = fn
+        self.idx = idx
+        self.env = env
+        self.tainted: Dict[str, _Taint] = {}
+        #: names bound to factory-call results: ``step = make_step(...)``
+        self.local_factories: Dict[str, Tuple[int, ...]] = {}
+        self.findings: List[Finding] = []
+        self._reported: set = set()
+
+    # ------------------------------------------------------------------ driver
+
+    def run(self) -> List[Finding]:
+        body = getattr(self.fn.node, "body", [])
+        self._process_block(body)
+        # donated self-attributes never rebound in this method outlive the call
+        for taint in self.tainted.values():
+            if taint.expr.startswith("self."):
+                self._report(
+                    taint.line,
+                    0,
+                    f"{taint.expr} is donated to '{taint.callee}' and never rebound in "
+                    f"this method: the attribute now holds a deleted buffer, and any "
+                    f"later read (from any method) is a use-after-donate; rebind it "
+                    f"from the call's outputs",
+                )
+        return self.findings
+
+    def _process_block(self, stmts) -> None:
+        for stmt in stmts:
+            self._process_stmt(stmt)
+
+    def _process_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs run later, under their own frame
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._process_assign(stmt)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._kill_target(t)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_reads(stmt.iter)
+            self._collect_donations(stmt.iter)
+            self._kill_target(stmt.target)
+            self._process_loop(stmt.body)
+            self._process_block(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._check_reads(stmt.test)
+            self._process_loop(stmt.body)
+            self._process_block(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self._check_reads(stmt.test)
+            self._collect_donations(stmt.test)
+            self._process_block(stmt.body)
+            self._process_block(stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            self._process_block(stmt.body)
+            for handler in stmt.handlers:
+                self._process_block(handler.body)
+            self._process_block(stmt.orelse)
+            self._process_block(stmt.finalbody)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_reads(item.context_expr)
+                self._collect_donations(item.context_expr)
+                if item.optional_vars is not None:
+                    self._kill_target(item.optional_vars)
+            self._process_block(stmt.body)
+        else:
+            # Expr / Return / Raise / Assert / aug-free statements: reads, then
+            # any donations they perform
+            for value in ast.iter_child_nodes(stmt):
+                if isinstance(value, ast.expr):
+                    self._check_reads(value)
+                    self._collect_donations(value)
+
+    def _process_assign(self, stmt) -> None:
+        value = getattr(stmt, "value", None)
+        if value is not None:
+            self._check_reads(value)
+            self._collect_donations(value)
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        if isinstance(stmt, ast.AugAssign):
+            self._check_reads(stmt.target)  # x += 1 reads x first
+        for t in targets:
+            self._kill_target(t)
+        if value is not None:
+            # AFTER the kills: ``s = make_factory()`` must survive its own
+            # statement's rebinding of ``s``
+            self._bind_factories(stmt, value)
+
+    def _process_loop(self, body) -> None:
+        """Process a loop body twice: the second pass starts from the taints
+        the first pass left alive, so a donation whose rebind happens EARLIER
+        in the body (next iteration kills before the read) stays silent while
+        a genuine loop-carried donation is read at its own call site."""
+        self._process_block(body)
+        survivors = {k: t for k, t in self.tainted.items()}
+        for t in survivors.values():
+            t.loop_pass = True
+        self._process_block(body)
+        # taints re-created by the replay are duplicates of pass one
+        for key, taint in list(self.tainted.items()):
+            if taint.loop_pass:
+                taint.loop_pass = False
+
+    # ----------------------------------------------------------------- helpers
+
+    def _bind_factories(self, stmt, value: ast.AST) -> None:
+        """Track ``step = make_lm_train_step(...)`` so later ``step(...)``
+        call sites donate."""
+        if not isinstance(value, ast.Call):
+            return
+        positions = self.env.factory_call_positions(value, self.idx, self.fn)
+        if not positions:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.local_factories[t.id] = positions
+
+    def _collect_donations(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            positions, callee = self.env.donating_positions(
+                node, self.idx, self.fn, self.local_factories
+            )
+            if not positions:
+                continue
+            for expr_str, _arg in donated_arg_exprs(node, positions):
+                self.tainted[expr_str] = _Taint(expr_str, node.lineno, callee or "jitted callable")
+
+    def _check_reads(self, expr: ast.AST) -> None:
+        if not self.tainted:
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+                continue
+            try:
+                key = ast.unparse(node)
+            except Exception:  # pragma: no cover
+                continue
+            taint = self.tainted.get(key)
+            if taint is None:
+                continue
+            self._report(
+                node.lineno,
+                node.col_offset,
+                f"{key} was donated to '{taint.callee}' at line {taint.line} and read "
+                f"again here: the buffer is deleted after the donating call; rebind it "
+                f"from the call's outputs before any further use"
+                + (
+                    " (this read happens on the loop's next iteration)"
+                    if taint.loop_pass
+                    else ""
+                ),
+            )
+
+    def _kill_target(self, target: ast.AST) -> None:
+        """An assignment to an expression (or its base name) ends its taint."""
+        targets = target.elts if isinstance(target, (ast.Tuple, ast.List)) else [target]
+        for t in targets:
+            if isinstance(t, ast.Starred):
+                t = t.value
+            try:
+                key = ast.unparse(t)
+            except Exception:  # pragma: no cover
+                continue
+            self.tainted.pop(key, None)
+            self.local_factories.pop(key, None)
+            # rebinding the base kills every taint reached through it:
+            # ``state = ...`` clears ``state['cache']``
+            base = key.split(".", 1)[0].split("[", 1)[0]
+            for k in list(self.tainted):
+                if k == key:
+                    continue
+                k_base = k.split(".", 1)[0].split("[", 1)[0]
+                if k_base == base and (k.startswith(key) or key == k_base):
+                    del self.tainted[k]
+
+    def _report(self, line: int, col: int, message: str) -> None:
+        dedup = (line, message.split(";")[0])
+        if dedup in self._reported:
+            return
+        self._reported.add(dedup)
+        self.findings.append(
+            Finding(
+                "use-after-donate",
+                self.idx.source.relpath,
+                line,
+                col,
+                message,
+                symbol=self.fn.qualname,
+            )
+        )
+
+
+@register(
+    "use-after-donate",
+    "reads of a buffer after it was passed in a donate_argnums position (dataflow)",
+)
+def check(project: Project):
+    env = DonationEnv(project.graph)
+    for idx in project.graph.indexes:
+        for fn in idx.functions.values():
+            yield from _FunctionWalk(fn, idx, env).run()

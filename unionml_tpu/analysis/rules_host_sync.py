@@ -17,6 +17,14 @@ Two walks, two severity models:
 
 The walk is a call-graph traversal (``CallGraph.reachable``), not a syntactic
 scan: a helper three calls below ``DecodeEngine.step`` is as hot as ``step``.
+
+v2 (dataflow retrofit): the host-hot-path conversion check follows ALIASES,
+not just spellings. v1 flagged ``bool(self._active_dev)`` by the ``_dev``
+suffix alone, so ``x = self._tokens; bool(x)`` — where ``__init__`` assigned
+``self._tokens = jnp.zeros(...)`` — sailed through. The dataflow layer's
+provenance pass (:func:`~unionml_tpu.analysis.dataflow.device_locals`) tracks
+device-resident class attributes and the locals assigned from them, so the
+renamed value is caught.
 """
 
 import ast
@@ -24,6 +32,7 @@ from typing import Iterator, List, Set, Tuple
 
 from unionml_tpu.analysis.callgraph import FunctionInfo, dotted
 from unionml_tpu.analysis.core import Finding, Project, register
+from unionml_tpu.analysis.dataflow import device_attrs, device_locals, shape_locals
 
 #: numpy entry points that force a tracer onto the host
 _NP_SYNCS = {"asarray", "array"}
@@ -61,6 +70,7 @@ def _finding(fn: FunctionInfo, node: ast.AST, message: str) -> Finding:
 
 def _check_traced_body(fn: FunctionInfo) -> Iterator[Finding]:
     idx = fn.module
+    shape_derived: Set[str] = None  # lazily: aliases of shape arithmetic
     for node in ast.walk(fn.node):
         if isinstance(node, ast.Call):
             name = dotted(node.func) or ""
@@ -85,12 +95,24 @@ def _check_traced_body(fn: FunctionInfo) -> Iterator[Finding]:
                 )
             elif isinstance(node.func, ast.Name) and node.func.id in _CONVERSIONS and node.args:
                 arg = node.args[0]
-                if not isinstance(arg, ast.Constant) and not _expr_mentions_shape(arg):
-                    yield _finding(
-                        fn, node,
-                        f"{node.func.id}() on a traced value concretizes it "
-                        "(ConcretizationTypeError or a baked constant)",
-                    )
+                if isinstance(arg, ast.Constant) or _expr_mentions_shape(arg):
+                    continue
+                if shape_derived is None:
+                    shape_derived = shape_locals(fn)
+                # dataflow: ``num_tokens, _ = gates.shape`` makes num_tokens
+                # trace-time python — int(num_tokens * k) is not a sync. Any
+                # shape-derived name in the expression marks it trace-time
+                # arithmetic (silence over noise: mixed expressions are rare)
+                names = {
+                    sub.id for sub in ast.walk(arg) if isinstance(sub, ast.Name)
+                }
+                if names & shape_derived:
+                    continue
+                yield _finding(
+                    fn, node,
+                    f"{node.func.id}() on a traced value concretizes it "
+                    "(ConcretizationTypeError or a baked constant)",
+                )
         elif isinstance(node, (ast.If, ast.While)) and _jnp_call_in(node.test, idx):
             yield _finding(
                 fn, node.test,
@@ -99,8 +121,31 @@ def _check_traced_body(fn: FunctionInfo) -> Iterator[Finding]:
             )
 
 
+def _device_names_in(arg: ast.AST, fn: FunctionInfo, aliases: Set[str],
+                     dev_attrs: Set[str]) -> List[str]:
+    """Names/attrs in ``arg`` provably holding device values: the ``_dev``
+    suffix convention, device-aliased locals, and device class attributes."""
+    hits: List[str] = []
+    for sub in ast.walk(arg):
+        if isinstance(sub, ast.Name):
+            if sub.id.endswith("_dev") or sub.id in aliases:
+                hits.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr.endswith("_dev"):
+                hits.append(sub.attr)
+            elif (
+                isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and sub.attr in dev_attrs
+            ):
+                hits.append(f"self.{sub.attr}")
+    return hits
+
+
 def _check_host_hot_path(fn: FunctionInfo) -> Iterator[Finding]:
     idx = fn.module
+    aliases: Set[str] = None  # computed lazily: most hot functions never convert
+    dev_attrs: Set[str] = None
     for node in ast.walk(fn.node):
         if not isinstance(node, ast.Call):
             continue
@@ -121,17 +166,19 @@ def _check_host_hot_path(fn: FunctionInfo) -> Iterator[Finding]:
                 "fuse fetches or move the consumer off-path",
             )
         elif isinstance(node.func, ast.Name) and node.func.id in _CONVERSIONS and node.args:
-            # only flag conversions of device-mirror state: the `_dev`-suffix
-            # convention marks arrays that live on device in steady state
-            names = {
-                sub.attr if isinstance(sub, ast.Attribute) else getattr(sub, "id", "")
-                for sub in ast.walk(node.args[0])
-            }
-            if any(n.endswith("_dev") for n in names if n):
+            # only flag conversions of PROVABLY device-resident state: the
+            # `_dev`-suffix convention, plus the dataflow provenance pass
+            # (device class attrs and the locals aliasing them)
+            if aliases is None:
+                aliases = device_locals(fn, idx)
+                dev_attrs = device_attrs(idx, fn.class_name) if fn.class_name else set()
+            hits = _device_names_in(node.args[0], fn, aliases, dev_attrs)
+            if hits:
                 yield _finding(
                     fn, node,
-                    f"{node.func.id}() on a device-resident mirror fetches it to the host "
-                    "every tick; keep the decision on device or batch the fetch",
+                    f"{node.func.id}() on device-resident value(s) {', '.join(sorted(set(hits)))} "
+                    "fetches to the host every tick; keep the decision on device "
+                    "or batch the fetch",
                 )
 
 

@@ -100,6 +100,7 @@ def load_native_library() -> Optional[ctypes.CDLL]:
             return _lib
         lib_path = _build_dir() / _LIB_NAME
         try:
+            # graftlint: disable=lock-order -- the lock intentionally serializes the ONE-TIME g++ build: concurrent first callers must wait for the compile rather than race it; every later call returns the cached handle without blocking
             lib = _build_and_load(lib_path)
         except (subprocess.CalledProcessError, OSError, FileNotFoundError) as exc:
             detail = getattr(exc, "stderr", b"")
@@ -127,6 +128,7 @@ def load_native_library() -> Optional[ctypes.CDLL]:
                         exc,
                     )
                     try:
+                        # graftlint: disable=lock-order -- same one-time-build serialization as above: the stale-cache self-heal rebuild must also complete before any caller proceeds
                         lib = _rebuild_and_load_fresh(lib_path)
                         continue
                     except (subprocess.CalledProcessError, OSError, FileNotFoundError) as build_exc:

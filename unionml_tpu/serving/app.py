@@ -177,6 +177,7 @@ def build_aiohttp_app(
     async def on_startup(app):
         load_model_artifact(model, remote=remote, app_version=app_version, model_version=model_version)
         if predictor is not None:
+            # graftlint: disable=async-blocking -- startup hook: the warmup compile+hard_sync runs before the server accepts any traffic, so blocking the (idle) loop here is the point
             predictor.setup()
         if generator is not None:
             from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
@@ -250,7 +251,11 @@ def build_aiohttp_app(
                         if predictor is not None
                         else model.predict(features=features),
                     )
-            return web.json_response(jsonable(result))
+            # jsonable() may device_get prediction arrays (graftlint
+            # async-blocking true positive, fixed): fetch off the event loop,
+            # like the predictor calls above
+            payload = await loop.run_in_executor(None, jsonable, result)
+            return web.json_response(payload)
         except Exception as exc:
             logger.exception("Prediction failed")
             return web.json_response({"detail": f"Prediction failed: {exc}"}, status=500)

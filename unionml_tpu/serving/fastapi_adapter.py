@@ -58,14 +58,21 @@ def attach_fastapi(
     async def setup_model():
         load_model_artifact(model, remote=remote, app_version=app_version, model_version=model_version)
         if predictor is not None:
+            # graftlint: disable=async-blocking -- startup hook: the warmup compile+hard_sync runs before the server accepts any traffic, so blocking the (idle) loop here is the point
             predictor.setup()
 
     @app.get("/", response_class=HTMLResponse)
     def root():
         return _INDEX_HTML
 
+    # SYNC on purpose (graftlint async-blocking true positive, fixed): the
+    # compiled predictor call and its device fetch block for milliseconds+,
+    # which on an ``async def`` endpoint stalls the event loop for every
+    # in-flight request. FastAPI runs sync endpoints in its threadpool — same
+    # contract, no loop stall (the aiohttp app routes through run_in_executor
+    # for the same reason).
     @app.post("/predict")
-    async def predict(
+    def predict(
         inputs: Optional[Union[Dict[str, Any], None]] = Body(None),
         features: Optional[List[Any]] = Body(None),
     ):

@@ -166,6 +166,7 @@ class SpeculativeBatcher:
             self.engine.num_active = 1
             self.engine.requests_admitted += 1
             try:
+                # graftlint: disable=lock-order -- _lock EXISTS to serialize device work across requests (single-stream design, see class docstring); blocking under it is the design, and _await_turn admits exactly one holder
                 out = speculative_generate(
                     self._target,
                     self._target_variables,

@@ -141,14 +141,17 @@ class TracedFunction:
         key = frozenset(static_names)
         compiled = self._compiled.get(key)
         if compiled is None:
-            jit_kwargs: Dict[str, Any] = {"static_argnames": static_names or None}
-            if self._donate_argnums:
-                jit_kwargs["donate_argnums"] = self._donate_argnums
+            # sharding kwargs stay conditional (passing None is not the same as
+            # omitting them), but the donation is declared explicitly so static
+            # analysis sees that this callable may consume its args' buffers
+            jit_kwargs: Dict[str, Any] = {}
+            if static_names:
+                jit_kwargs["static_argnames"] = static_names
             if self._in_shardings is not None:
                 jit_kwargs["in_shardings"] = self._in_shardings
             if self._out_shardings is not None:
                 jit_kwargs["out_shardings"] = self._out_shardings
-            compiled = jax.jit(self._fn, **{k: v for k, v in jit_kwargs.items() if v is not None})
+            compiled = jax.jit(self._fn, donate_argnums=self._donate_argnums, **jit_kwargs)
             self._compiled[key] = compiled
         return compiled
 
@@ -176,6 +179,7 @@ class TracedFunction:
                     # would otherwise grow it for the process lifetime; clearing
                     # just means an occasional re-attempted (failing) trace
                     self._trace_failed_keys.clear()
+                # graftlint: disable=use-after-donate -- reads only shape/dtype metadata, which survives donation (and trace failures raise before any donation executes)
                 self._trace_failed_keys.add(self._trace_key(static_names, args, kwargs))
                 logger.info(
                     "%s: jit tracing failed (%s: %s); falling back to eager execution for this call signature.",
@@ -183,6 +187,7 @@ class TracedFunction:
                     type(exc).__name__,
                     exc,
                 )
+                # graftlint: disable=use-after-donate -- safe ONLY because every _TRACE_FAILURES type raises at trace time, before the executable runs: donation consumes buffers at execution, so the args are intact here; execution-time failures re-raise below. Widening _TRACE_FAILURES to any runtime error type would make this a real use-after-donate.
                 return self._fn(*args, **kwargs)
             if self._policy == "auto":
                 # runtime failure of an already-compiled executable (or an error the
