@@ -35,7 +35,7 @@ def run():
     try:
         if jax.config.jax_compilation_cache_dir is None:
             jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
-    except Exception:  # noqa: BLE001
+    except Exception:  # graftlint: disable=swallowed-exception -- the compilation cache is an optimization, never a failure
         pass
 
     import jax.numpy as jnp

@@ -77,7 +77,7 @@ class _RetraceCounter:
             return
         try:
             self._monitoring._unregister_event_duration_listener_by_callback(self._listener)
-        except Exception:  # listener API drift: a leaked counter only overcounts
+        except Exception:  # graftlint: disable=swallowed-exception -- jax.monitoring listener API drift: a leaked counter only overcounts retraces
             pass
 
 
@@ -830,6 +830,91 @@ def bench_slo_mix(n_batch: int = 24, n_interactive: int = 8, num_slots: int = 4,
     return out
 
 
+def bench_chaos(n_requests: int = 8, max_new_tokens: int = 24, num_slots: int = 4,
+                mesh_devices: int = 0):
+    """Chaos smoke: recovery latency + recovered-token parity under injected
+    engine failures (ISSUE 7's `tpu_window.sh` gate).
+
+    A flood of requests runs twice on identically-seeded engines: once clean,
+    once with a ``FaultPlan`` that kills a decode dispatch mid-flood and NaNs
+    one slot's logits a little later. The supervised batcher must salvage the
+    in-flight transcripts, rebuild, and resume — the report asserts what the
+    chaos *suite* pins functionally, but MEASURED: how long a failure->ok
+    transition takes wall-clock (``recovery_ms``), how many requests
+    recovered vs died, and whether every recovered stream matched the clean
+    run token-for-token (``parity``). The poisoned request must fail
+    structured (reason ``nan_logits``), never hang."""
+    import asyncio
+
+    from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+    from unionml_tpu.serving.faults import EngineFailure, FaultPlan
+    from unionml_tpu.serving.supervisor import EngineSupervisor
+
+    config, model, variables = _bench_gpt()
+    mesh = _serving_mesh(mesh_devices, config.num_heads) if mesh_devices else None
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config.vocab_size, size=6).tolist() for _ in range(n_requests)]
+
+    def run(faults):
+        engine = DecodeEngine(
+            model, variables, num_slots=num_slots, max_len=128,
+            # the ladder must hold a salvaged TRANSCRIPT (prompt + decoded
+            # tokens), not just the prompts: resumes re-admit through it
+            prefill_buckets=(8, 64),
+            mesh=mesh, prefix_cache_blocks=128, prefix_block_size=8, faults=faults,
+        )
+        supervisor = EngineSupervisor(backoff_s=0.01, watchdog_interval_s=0.1)
+        batcher = ContinuousBatcher(engine, supervisor=supervisor)
+
+        async def drive():
+            return await asyncio.gather(
+                *(batcher.generate(p, max_new_tokens) for p in prompts),
+                return_exceptions=True,
+            )
+
+        t0 = time.perf_counter()
+        results = asyncio.run(drive())
+        total_s = time.perf_counter() - t0
+        stats = supervisor.stats()
+        pinned = engine.prefix_cache.pinned_blocks
+        batcher.close()
+        return results, stats, total_s, pinned
+
+    clean, _, clean_s, _ = run(None)
+    plan = FaultPlan(step_dispatch_failures=(12,), nan_logits=((30, 1),))
+    chaotic, stats, chaos_s, pinned = run(plan)
+
+    recovered = failed = mismatched = hung = 0
+    for want, got in zip(clean, chaotic):
+        if isinstance(got, EngineFailure):
+            failed += 1
+        elif isinstance(got, Exception):
+            hung += 1  # anything non-structured counts against the contract
+        elif got == want:
+            recovered += 1
+        else:
+            mismatched += 1
+    return {
+        "n_requests": n_requests,
+        "max_new_tokens": max_new_tokens,
+        "num_slots": num_slots,
+        "mesh_devices": mesh_devices or 1,
+        "faults_injected": plan.stats()["injected"],
+        "recovered": recovered,
+        "failed_structured": failed,
+        "mismatched": mismatched,
+        "unstructured_failures": hung,
+        "parity": mismatched == 0 and hung == 0,
+        "recovery_ms": stats["last_recovery_ms"],
+        "rebuilds": stats["rebuilds"],
+        "quarantines": failed,
+        "pinned_blocks_leaked": pinned,
+        "clean_total_s": round(clean_s, 4),
+        "chaos_total_s": round(chaos_s, 4),
+        "chaos_overhead_x": round(chaos_s / clean_s, 3) if clean_s else None,
+    }
+
+
 def bench_speculative(iters: int = 20, max_new_tokens: int = 32, gamma: int = 4):
     """Speculative vs plain single-stream /generate latency over real HTTP.
 
@@ -911,6 +996,13 @@ def main():
                         "p50/p95/p99 plus shed/preempt/deadline-miss counts. Runs "
                         "ONLY this phase (like --pipeline); combine with --mesh N "
                         "to run it over an N-device mesh")
+    parser.add_argument("--chaos", action="store_true",
+                        help="focused fault-injection smoke: a request flood with an "
+                        "injected mid-flood engine failure plus a NaN-logits slot, "
+                        "through the supervised batcher — reports recovery latency, "
+                        "recovered-token parity vs a clean run, structured-failure "
+                        "counts, and pinned-block leaks. Runs ONLY this phase (like "
+                        "--slo-mix); combine with --mesh N for the sharded engine")
     parser.add_argument("--pipeline", choices=("on", "off", "ab"), default=None,
                         help="focused depth-1 pipelined-decode phase: decode tok/s + "
                         "host-gap ms at lookahead=1 with dispatch-ahead on/off "
@@ -932,7 +1024,7 @@ def main():
     from bench_util import resolve_artifact_path
 
     backend = jax.default_backend()
-    if args.pipeline or args.mesh or args.slo_mix:
+    if args.pipeline or args.mesh or args.slo_mix or args.chaos:
         import os
 
         base, ext = os.path.splitext(args.out)
@@ -940,6 +1032,8 @@ def main():
             base = f"{base}_pipeline"
         if args.slo_mix:
             base = f"{base}_slo"
+        if args.chaos:
+            base = f"{base}_chaos"
         if args.mesh:
             base = f"{base}_mesh{args.mesh}"
         args.out = f"{base}{ext}"
@@ -950,6 +1044,27 @@ def main():
         "cold_start_excluded": True,
         "models": {},
     }
+
+    if args.chaos:
+        if args.mesh and len(jax.devices()) < args.mesh:
+            print(json.dumps({"metric": "chaos_recovery_ms",
+                              "error": f"--mesh {args.mesh} needs {args.mesh} devices, "
+                              f"found {len(jax.devices())}", "backend": backend}))
+            return 1
+        chaos = bench_chaos(mesh_devices=args.mesh)
+        results["models"]["chaos" + (f"_mesh{args.mesh}" if args.mesh else "")] = chaos
+        print(json.dumps({"metric": "chaos_recovery_ms", "backend": backend,
+                          "value": chaos["recovery_ms"],
+                          "recovered": chaos["recovered"],
+                          "failed_structured": chaos["failed_structured"],
+                          "parity": chaos["parity"],
+                          "pinned_blocks_leaked": chaos["pinned_blocks_leaked"],
+                          "mesh_devices": args.mesh or 1}))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        # the smoke GATES: parity or leaks failing here must fail the battery step
+        return 0 if (chaos["parity"] and chaos["pinned_blocks_leaked"] == 0) else 1
 
     if args.slo_mix:
         if args.mesh and len(jax.devices()) < args.mesh:

@@ -49,7 +49,7 @@ def _configure_compilation_cache(jax) -> None:
         if jax.config.jax_compilation_cache_dir is None:
             jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
-    except Exception:  # noqa: BLE001 - cache is an optimization, never a failure
+    except Exception:  # graftlint: disable=swallowed-exception -- the compilation cache is an optimization, never a failure
         pass
 
 if "jax" in sys.modules:
@@ -62,7 +62,7 @@ if "jax" in sys.modules:
         # break pallas/checkify lowering registration at import time.)
         jax.config.update("jax_platforms", "cpu")
         _configure_compilation_cache(jax)
-    except Exception:  # noqa: BLE001 - best effort; env vars above still apply
+    except Exception:  # graftlint: disable=swallowed-exception -- best-effort platform pin; the env vars above still apply
         pass
 
 REPO_ROOT = Path(__file__).resolve().parent.parent

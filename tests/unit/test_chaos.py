@@ -1,0 +1,722 @@
+"""Chaos suite: deterministic fault injection against the serving core.
+
+Tier-1 gate for ISSUE 7 (fault-injection harness + supervised recovery). The
+contract pinned here, per injected fault class, on CPU meshes (1-device and
+4-device tensor-parallel):
+
+- **Recoverable faults** (step-dispatch death, deferred token-fetch death,
+  pool exhaustion, fetch stalls): every affected request COMPLETES and its
+  output is TOKEN-IDENTICAL to a fault-free run — greedy and fixed-seed
+  sampled (the rebuilt engine replays the PRNG stream to the cut point).
+- **Attributable faults** (a single request's prefill dying, one slot's
+  logits going NaN/Inf): only that request fails — with a structured,
+  machine-readable reason — while every sibling's output stays exact.
+- **Unrecoverable engines** (rebuild budget exhausted): everything fails
+  promptly and structurally; nothing hangs; the supervisor reports
+  ``failed`` and new work is refused fast.
+- **No pinned-block leaks**: after every scenario — including rebuilds,
+  preempt-then-failure, and teardown mid-chunked-prefill — the prefix
+  cache's pin counter and every node refcount return to zero.
+- **Scheduler tickets survive recovery**: priorities and deadlines ride
+  through salvage/requeue unchanged, so SLO enforcement still fires.
+"""
+
+import asyncio
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+from unionml_tpu.serving.faults import EngineFailure, FaultError, FaultPlan
+from unionml_tpu.serving.scheduler import DeadlineExceededError
+from unionml_tpu.serving.supervisor import EngineSupervisor
+
+
+@pytest.fixture(scope="module")
+def gpt(gpt_tiny_session):
+    _, model, variables = gpt_tiny_session
+    return model, variables
+
+
+def _mesh4():
+    from unionml_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices (conftest forces 8 CPU devices)")
+    return make_mesh({"tensor": 4}, devices=jax.devices()[:4])
+
+
+def _engine(model, variables, mesh=None, faults=None, cache=True, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", (8, 16, 32))
+    if cache:
+        kw.setdefault("prefix_cache_blocks", 64)
+        kw.setdefault("prefix_block_size", 4)
+    return DecodeEngine(model, variables, mesh=mesh, faults=faults, **kw)
+
+
+def _supervisor(**kw):
+    kw.setdefault("watchdog_interval_s", 0)  # tests drive check() synchronously
+    kw.setdefault("backoff_s", 0.005)
+    kw.setdefault("backoff_max_s", 0.02)
+    return EngineSupervisor(**kw)
+
+
+def _assert_no_pins_or_refs(engine):
+    if engine.prefix_cache is None:
+        return
+    assert engine.prefix_cache.pinned_blocks == 0
+    stack = list(engine.prefix_cache._root.children.values())
+    while stack:
+        node = stack.pop()
+        assert node.refcount == 0, "leaked prefix-cache reference"
+        stack.extend(node.children.values())
+
+
+PROMPT_A, BUDGET_A = [3, 1, 4, 1, 5], 12
+PROMPT_B, BUDGET_B = [2, 7, 1], 10
+
+
+def _run_pair(model, variables, mesh=None, faults=None, sup=None, cache=True, **genkw):
+    """Drive two concurrent requests through a (possibly fault-injected)
+    supervised batcher; returns their outputs plus the engine."""
+    engine = _engine(model, variables, mesh=mesh, faults=faults, cache=cache)
+    batcher = ContinuousBatcher(engine, supervisor=sup)
+
+    async def main():
+        return await asyncio.gather(
+            batcher.generate(PROMPT_A, BUDGET_A, **genkw),
+            batcher.generate(PROMPT_B, BUDGET_B, **genkw),
+            return_exceptions=True,
+        )
+
+    try:
+        results = asyncio.run(main())
+    finally:
+        batcher.close()
+    return results, engine
+
+
+# ------------------------------------------------- recoverable: token parity
+
+
+@pytest.mark.parametrize("mesh4", [False, True], ids=["1dev", "mesh4"])
+@pytest.mark.parametrize(
+    "plan_kw",
+    [dict(step_dispatch_failures=(4,)), dict(step_fetch_failures=(3,))],
+    ids=["dispatch_fault", "deferred_fetch_fault"],
+)
+def test_engine_failure_recovers_token_identical_greedy(gpt, mesh4, plan_kw):
+    """A device fault mid-decode costs nothing observable: every in-flight
+    request resumes from its salvaged transcript (suffix prefill over the
+    pinned prefix blocks) and finishes token-identical to a fault-free run."""
+    model, variables = gpt
+    mesh = _mesh4() if mesh4 else None
+    expected, _ = _run_pair(model, variables, mesh=mesh)
+    sup = _supervisor()
+    results, engine = _run_pair(model, variables, mesh=mesh, faults=FaultPlan(**plan_kw), sup=sup)
+    assert results == expected
+    assert engine.failure_count == 1 and engine.rebuilds >= 1
+    assert sup.stats()["health"] == "ok"
+    assert sup.stats()["recovered_requests"] == 2
+    assert sup.stats()["failed_requests"] == 0
+    _assert_no_pins_or_refs(engine)
+
+
+@pytest.mark.parametrize("mesh4", [False, True], ids=["1dev", "mesh4"])
+def test_engine_failure_recovers_token_identical_fixed_seed_sampled(gpt, mesh4):
+    """Sampled streams survive recovery bit-exactly: the rebuilt engine
+    replays the recorded key advances from the seeded base, so the resumed
+    decode consumes the SAME per-step subkeys a fault-free engine would."""
+    model, variables = gpt
+    mesh = _mesh4() if mesh4 else None
+
+    def run(faults, sup=None):
+        engine = _engine(model, variables, mesh=mesh, faults=faults, temperature=0.8, seed=7)
+        batcher = ContinuousBatcher(engine, supervisor=sup)
+
+        async def main():
+            return await asyncio.gather(
+                batcher.generate(PROMPT_A, BUDGET_A, temperature=0.8),
+                batcher.generate(PROMPT_B, BUDGET_B, temperature=0.8),
+            )
+
+        try:
+            out = asyncio.run(main())
+        finally:
+            batcher.close()
+        _assert_no_pins_or_refs(engine)
+        return out
+
+    clean = run(None)
+    recovered = run(FaultPlan(step_fetch_failures=(3,)), sup=_supervisor())
+    assert recovered == clean
+
+
+def test_recovery_works_without_prefix_cache(gpt):
+    """No cache, no pinned blocks to resume from — salvage still recovers
+    token-identically by re-prefilling the full transcript (host-retained)."""
+    model, variables = gpt
+    expected, _ = _run_pair(model, variables, cache=False)
+    sup = _supervisor()
+    results, engine = _run_pair(
+        model, variables, faults=FaultPlan(step_dispatch_failures=(5,)), sup=sup, cache=False
+    )
+    assert results == expected
+    assert sup.stats()["recovered_requests"] == 2
+
+
+def test_unsupervised_failure_fails_structured_then_serves(gpt):
+    """Without a supervisor the old contract holds — every in-flight request
+    fails — but now with a structured reason, zero leaked pins, and an engine
+    that serves the very next request exactly."""
+    model, variables = gpt
+    results, engine = _run_pair(
+        model, variables, faults=FaultPlan(step_dispatch_failures=(4,)), sup=None
+    )
+    assert all(isinstance(r, EngineFailure) for r in results)
+    assert all(r.reason == "injected_step_dispatch" for r in results)
+    _assert_no_pins_or_refs(engine)
+    assert engine.generate(PROMPT_A, 6) == _engine(model, variables).generate(PROMPT_A, 6)
+
+
+# --------------------------------------------- attributable: per-request only
+
+
+def _recorder():
+    class Sink:
+        cancelled = False
+
+        def __init__(self):
+            self.tokens, self.done, self.error = [], False, None
+
+        def emit(self, token):
+            self.tokens.append(token)
+
+        def finish(self):
+            self.done = True
+
+        def fail(self, exc):
+            self.error = exc
+
+    return Sink()
+
+
+def test_prefill_failure_fails_only_that_request(gpt, gpt_tiny_solo):
+    """A batched admission whose prefill dispatch dies rolls back atomically,
+    re-admits per-request, and fails ONLY the raiser (structured); siblings
+    admit and decode exactly. Injection: batch prefill #1 and the raiser's
+    individual retry #2 both fail, retries #3/#4 succeed."""
+    model, variables = gpt
+    engine = _engine(
+        model, variables, num_slots=4,
+        faults=FaultPlan(prefill_failures=(1, 2)),
+    )
+    batcher = ContinuousBatcher(engine)
+    prompts = [[3, 1, 4], [2, 7, 5], [9, 9, 1]]
+    sinks = [_recorder() for _ in prompts]
+    for prompt, sink in zip(prompts, sinks):
+        ticket = batcher.scheduler.make_ticket(
+            np.asarray(prompt, dtype=np.int32), 5, {}, sink
+        )
+        batcher.scheduler.submit(ticket)
+    batcher._admit()  # worker not started: drive the admission deterministically
+    while batcher._sinks:
+        batcher._dispatch_events(engine.step())
+    assert isinstance(sinks[0].error, EngineFailure)
+    assert sinks[0].error.reason == "injected_prefill"
+    for prompt, sink in zip(prompts[1:], sinks[1:]):
+        assert sink.done and sink.error is None
+        assert sink.tokens == gpt_tiny_solo(prompt, 5)
+    assert engine.failure_count == 0  # never escalated to an engine failure
+    _assert_no_pins_or_refs(engine)
+
+
+def test_chunked_prefill_failure_kills_only_that_slot(gpt, gpt_tiny_solo):
+    """A chunk dispatch dying mid-chunked-prefill drops that request with a
+    structured ``prefill_failed`` event; a decoding sibling is untouched."""
+    model, variables = gpt
+    engine = _engine(
+        model, variables, prefill_buckets=(8, 32), prefill_chunk=4,
+        faults=FaultPlan(prefill_failures=(3,)),  # prefill #1 = sibling, #2/#3 = chunks
+    )
+    sibling = engine.add_request([2, 7], 8)
+    (chunked,) = engine.admit_many([(list(range(1, 15)), 5)])
+    out, events = [], []
+    while engine.num_active or engine.has_pending_prefill or engine.has_pending_events:
+        for ev in engine.step():
+            events.append(ev)
+            if ev.slot == sibling and ev.emit:
+                out.append(ev.token)
+    errors = [ev for ev in events if ev.error is not None]
+    assert len(errors) == 1 and errors[0].slot == chunked
+    assert errors[0].error == "prefill_failed" and errors[0].finished
+    assert out == gpt_tiny_solo([2, 7], 8)
+    _assert_no_pins_or_refs(engine)
+
+
+@pytest.mark.parametrize("mesh4", [False, True], ids=["1dev", "mesh4"])
+def test_nan_logits_quarantines_one_slot_siblings_exact(gpt, mesh4):
+    """A NaN storm in one slot's logits costs exactly that request: it fails
+    with the structured ``nan_logits`` reason (no garbage token delivered),
+    the sibling decodes to the fault-free stream, and nothing leaks."""
+    model, variables = gpt
+    mesh = _mesh4() if mesh4 else None
+    expected, _ = _run_pair(model, variables, mesh=mesh)
+    sup = _supervisor()
+    results, engine = _run_pair(
+        model, variables, mesh=mesh, faults=FaultPlan(nan_logits=((5, 0),)), sup=sup
+    )
+    assert isinstance(results[0], EngineFailure) and results[0].reason == "nan_logits"
+    assert results[1] == expected[1]  # the sibling never noticed
+    assert engine.quarantined_requests == 1
+    assert engine.failure_count == 0  # quarantine, not engine failure
+    assert sup.stats()["health"] == "ok"
+    _assert_no_pins_or_refs(engine)
+
+
+def test_nan_quarantine_sampled_sibling_parity(gpt):
+    """Sampled sibling streams are quarantine-invariant: the key advances on
+    ANY-active steps, so cancelling the poisoned slot never shifts the
+    sibling's subkey sequence."""
+    model, variables = gpt
+
+    def run(faults):
+        engine = _engine(model, variables, faults=faults, temperature=0.8, seed=11)
+        a = engine.add_request(PROMPT_A, 8, temperature=0.8)
+        b = engine.add_request(PROMPT_B, 8, temperature=0.8)
+        out = {a: [], b: []}
+        while engine.num_active or engine.has_pending_events:
+            for ev in engine.step():
+                if ev.emit:
+                    out[ev.slot].append(ev.token)
+        _assert_no_pins_or_refs(engine)
+        return out[a], out[b]
+
+    clean_a, clean_b = run(None)
+    _, faulty_b = run(FaultPlan(nan_logits=((3, 0),)))
+    assert faulty_b == clean_b
+    assert len(clean_a) == 8  # the clean run really did decode the poisoned-slot request
+
+
+def test_quarantined_slot_reuse_never_inherits_stale_burst_token(gpt, gpt_tiny_solo):
+    """Regression (found by the chaos bench): a quarantine fires DURING a
+    replay, when the next step is already dispatched under the old occupant's
+    active mask. Re-admitting into the freed slot before that burst drains
+    must NOT credit its garbage token to the new occupant — the burst's
+    replay skips the quarantined slot unconditionally."""
+    model, variables = gpt
+    engine = _engine(model, variables, num_slots=1, faults=FaultPlan(nan_logits=((3, 0),)))
+    engine.add_request(PROMPT_A, 10)
+    quarantined = False
+    for _ in range(20):
+        if any(ev.error == "nan_logits" for ev in engine.step()):
+            quarantined = True
+            break
+    assert quarantined
+    # the in-flight step dispatched before the quarantine still carries a
+    # stale slot-0 token; the new occupant must start with a clean stream
+    engine.add_request(PROMPT_B, 6)
+    out = []
+    while engine.num_active or engine.has_pending_events:
+        out.extend(ev.token for ev in engine.step() if ev.emit)
+    assert out == gpt_tiny_solo(PROMPT_B, 6)
+    _assert_no_pins_or_refs(engine)
+
+
+def test_pool_exhaustion_at_admit_degrades_gracefully(gpt, gpt_tiny_solo):
+    """An exhausted block pool at admission indexes nothing — the request
+    simply prefills in full and completes exactly (caching is an
+    optimization, never a correctness dependency)."""
+    model, variables = gpt
+    plan = FaultPlan(pool_exhausted_admits=(1,))
+    engine = _engine(model, variables, faults=plan)
+    prompt = list(range(1, 13))
+    assert engine.generate(prompt, 5) == gpt_tiny_solo(prompt, 5)
+    assert plan.observed.get("pool_exhausted", 0) >= 1
+    assert engine.prefix_cache.stats()["inserted_blocks"] == 0  # nothing indexed
+    # the next admission caches normally again
+    assert engine.generate(prompt, 5) == gpt_tiny_solo(prompt, 5)
+    assert engine.prefix_cache.stats()["inserted_blocks"] > 0
+    _assert_no_pins_or_refs(engine)
+
+
+# ------------------------------------------------------- watchdog & rebuilds
+
+
+def test_fetch_stall_trips_watchdog_then_recovers(gpt):
+    """An injected fetch stall (wedged device queue) trips the supervisor's
+    watchdog — health degrades, the trip is counted, the fault is recorded —
+    and health returns to ``ok`` once the heartbeat freshens. The stalled
+    request still completes exactly."""
+    model, variables = gpt
+    plan = FaultPlan(fetch_stalls=((2, 300.0),))
+    engine = _engine(model, variables, faults=plan)
+    sup = EngineSupervisor(
+        stall_timeout_s=0.05, watchdog_interval_s=0.02, backoff_s=0.005
+    )
+    batcher = ContinuousBatcher(engine, supervisor=sup)
+    try:
+        out = asyncio.run(batcher.generate(PROMPT_A, 8))
+    finally:
+        batcher.close()
+    assert out == _engine(model, variables).generate(PROMPT_A, 8)
+    # the thread may not have re-polled between the last heartbeat and close:
+    # one synchronous check settles the episode deterministically (idle
+    # engine -> not stalled -> degraded recovers to ok)
+    sup.check()
+    stats = sup.stats()
+    assert stats["watchdog_trips"] >= 1
+    assert stats["health"] == "ok"  # recovered once the heartbeat resumed
+    assert sup.last_fault is not None and sup.last_fault["reason"] == "watchdog_stall"
+    assert plan.injected.get("fetch_stall") == 1
+
+
+def test_watchdog_check_is_deterministic_synchronously(gpt):
+    """The watchdog predicate itself, no threads: busy + stale heartbeat
+    trips once per episode; a fresh heartbeat recovers ``degraded -> ok``."""
+    model, variables = gpt
+    engine = _engine(model, variables)
+    sup = _supervisor(stall_timeout_s=1.0)
+    sup.attach(engine)
+    engine.add_request(PROMPT_A, 4)
+    now = engine.last_heartbeat
+    assert not sup.check(now=now + 0.5)  # fresh: no stall
+    assert sup.check(now=now + 2.0)  # stale while busy: trip
+    assert sup.check(now=now + 3.0)  # same episode: still stalled, no double count
+    assert sup.stats()["watchdog_trips"] == 1
+    assert sup.state == "degraded"
+    engine.last_heartbeat = now + 10.0
+    assert not sup.check(now=now + 10.5)
+    assert sup.state == "ok"
+    while engine.num_active or engine.has_pending_events:
+        engine.step()
+
+
+def test_rebuild_backoff_succeeds_within_budget(gpt):
+    """Injected rebuild failures exercise the bounded-exponential-backoff
+    loop: the in-place rebuild fails, the supervisor retries, and the third
+    attempt lands — requests still recover token-identically."""
+    model, variables = gpt
+    expected, _ = _run_pair(model, variables)
+    sup = _supervisor(max_rebuild_attempts=3)
+    results, engine = _run_pair(
+        model, variables,
+        faults=FaultPlan(step_dispatch_failures=(4,), rebuild_failures=2),
+        sup=sup,
+    )
+    assert results == expected
+    stats = sup.stats()
+    assert stats["health"] == "ok"
+    assert stats["rebuild_attempts"] == 2  # in-place try + 1 failed retry + success
+    assert stats["recovered_requests"] == 2
+    _assert_no_pins_or_refs(engine)
+
+
+def test_rebuild_exhaustion_fails_everything_structured_and_fast(gpt):
+    """When the rebuild budget is exhausted the supervisor declares the
+    engine dead: every pending request fails with the structured terminal
+    error (zero hangs), and NEW submissions are refused immediately."""
+    model, variables = gpt
+    sup = _supervisor(max_rebuild_attempts=2)
+    engine = _engine(
+        model, variables,
+        faults=FaultPlan(step_dispatch_failures=(4,), rebuild_failures=99),
+    )
+    batcher = ContinuousBatcher(engine, supervisor=sup)
+
+    async def main():
+        results = await asyncio.gather(
+            batcher.generate(PROMPT_A, BUDGET_A),
+            batcher.generate(PROMPT_B, BUDGET_B),
+            return_exceptions=True,
+        )
+        with pytest.raises(EngineFailure) as fast:
+            await batcher.generate([5, 5], 4)
+        return results, fast.value
+
+    try:
+        results, fast = asyncio.run(main())
+    finally:
+        batcher.close()
+    assert sup.state == "failed"
+    for r in results:
+        assert isinstance(r, EngineFailure)
+        assert r.reason in ("engine_failed", "engine_rebuilding")
+    assert fast.reason == "engine_failed" and not fast.retryable
+    assert sup.stats()["failed_requests"] >= 2
+    _assert_no_pins_or_refs(engine)
+
+
+# -------------------------------------------- scheduler tickets across faults
+
+
+def test_deadlines_still_enforced_across_recovery(gpt):
+    """Scheduler tickets ride through salvage/requeue with their SLO intact:
+    a generous-deadline request survives the rebuild and completes exactly;
+    a tight-deadline request queued behind the incident gets its structured
+    504, not a hang."""
+    model, variables = gpt
+    expected = _engine(model, variables).generate(PROMPT_A, BUDGET_A)
+    sup = _supervisor()
+    engine = _engine(
+        model, variables, num_slots=1, faults=FaultPlan(step_dispatch_failures=(4,))
+    )
+    batcher = ContinuousBatcher(engine, supervisor=sup)
+
+    async def main():
+        hog = asyncio.ensure_future(
+            batcher.generate(PROMPT_A, BUDGET_A, deadline_ms=60_000)
+        )
+        while not engine.num_active:
+            await asyncio.sleep(0.005)
+        with pytest.raises(DeadlineExceededError):
+            await batcher.generate([4, 4], 4, deadline_ms=20)
+        return await hog
+
+    try:
+        out = asyncio.run(main())
+    finally:
+        batcher.close()
+    assert out == expected
+    assert sup.stats()["recovered_requests"] >= 1
+    misses = batcher.scheduler.stats()
+    assert misses["deadline_misses_queued"] + misses["deadline_misses_running"] >= 1
+    _assert_no_pins_or_refs(engine)
+
+
+# -------------------------------------------------- teardown races & pins
+
+
+def test_abort_all_mid_chunked_prefill_releases_everything(gpt, gpt_tiny_solo):
+    """abort_all() while a chunked prefill holds a restored-prefix path must
+    release every reference and pin; the engine serves exactly afterwards."""
+    model, variables = gpt
+    engine = _engine(model, variables, prefill_buckets=(8, 16, 32), prefill_chunk=4)
+    seed = list(range(1, 15))
+    assert engine.generate(seed, 4) == gpt_tiny_solo(seed, 4)  # populate the cache
+    engine.admit_many([(seed[:12] + [40] * 8, 5)])  # chunked, prefix-hit resumed
+    assert engine.has_pending_prefill
+    engine.abort_all()
+    _assert_no_pins_or_refs(engine)
+    assert engine.generate(seed, 4) == gpt_tiny_solo(seed, 4)
+
+
+def test_batcher_close_mid_chunked_prefill_no_pin_leak(gpt):
+    """close() racing an in-progress chunked prefill (reserved slot, held
+    prefix path) must fail the request promptly and leak nothing."""
+    model, variables = gpt
+    engine = _engine(model, variables, prefill_buckets=(8, 32), prefill_chunk=4)
+    batcher = ContinuousBatcher(engine)
+
+    async def main():
+        fut = asyncio.ensure_future(batcher.generate(list(range(1, 20)), 5))
+        while not engine.has_pending_prefill and not engine.num_active:
+            await asyncio.sleep(0.002)
+        batcher.close()
+        try:
+            await asyncio.wait_for(fut, timeout=5.0)
+        except (EngineFailure, RuntimeError):
+            pass  # completed-or-closed are both acceptable; hanging is not
+
+    asyncio.run(main())
+    _assert_no_pins_or_refs(engine)
+
+
+def test_preempt_then_engine_failure_keeps_checkpoint_resumable(gpt):
+    """An engine failure AFTER a preemption must not evict or leak the
+    preempted checkpoint: its pin survives the rebuild (the pool is
+    preserved), the resume pays only the uncovered suffix, and output parity
+    holds across preempt + failure + resume."""
+    model, variables = gpt
+    expected = _engine(model, variables).generate(PROMPT_A, BUDGET_A)
+    plan = FaultPlan()
+    engine = _engine(model, variables, faults=plan)
+    slot = engine.add_request(PROMPT_A, BUDGET_A)
+    out = []
+    for _ in range(5):
+        out.extend(ev.token for ev in engine.step() if ev.emit)
+    state = engine.preempt(slot)
+    assert state is not None and engine.prefix_cache.pinned_blocks == len(state.path) > 0
+    # the preempt flush buffered this slot's in-flight token: drain it under
+    # the old mapping (the batcher does exactly this) before the fault hits
+    out.extend(ev.token for ev in engine.take_pending_events() if ev.emit and ev.slot == slot)
+
+    # now the engine fails under another request, rebuilding in place
+    from unionml_tpu.serving.continuous import PreemptedSlot
+
+    engine.add_request(PROMPT_B, BUDGET_B)
+    plan.step_dispatch_failures = (plan._dispatches + 1,)
+    with pytest.raises(FaultError):
+        engine.step()
+    # the other request's salvage is abandoned (standalone owner releases it)
+    salvage = engine.take_salvage()
+    assert salvage
+    for rec in salvage:
+        engine.release_preempted(PreemptedSlot(tokens=rec.tokens, path=rec.path))
+    # the preempt checkpoint's pins survived the rebuild, nothing more is held
+    assert engine.prefix_cache.pinned_blocks == len(state.path)
+
+    hits_before = engine.prefix_cache.stats()["hits"]
+    engine.add_request(state.tokens, BUDGET_A - (len(state.tokens) - len(PROMPT_A)))
+    engine.release_preempted(state)
+    assert engine.prefix_cache.stats()["hits"] == hits_before + 1  # resumed via the pinned path
+    while engine.num_active or engine.has_pending_events:
+        out.extend(ev.token for ev in engine.step() if ev.emit)
+    assert out == expected
+    _assert_no_pins_or_refs(engine)
+
+
+def test_speculative_round_failure_is_structured_and_isolated(gpt):
+    """An injected speculative-round death fails that request with the
+    structured reason; the next request runs clean on the same facade.
+    close() mid-queue wakes waiters promptly (teardown race)."""
+    from unionml_tpu.serving import SpeculativeBatcher
+
+    model, variables = gpt
+    spec = SpeculativeBatcher(
+        model, variables, model, variables, gamma=2, max_len=64,
+        faults=FaultPlan(speculative_round_failures=(1,)),
+    )
+    with pytest.raises(EngineFailure) as err:
+        asyncio.run(spec.generate([3, 1, 4], 5))
+    assert err.value.reason == "speculative_round_failed"
+    assert spec.round_failures == 1
+    tokens = asyncio.run(spec.generate([3, 1, 4], 5))
+    assert len(tokens) == 5
+    spec.close()
+
+
+# ------------------------------------------------------------- HTTP surface
+
+
+def _app(model, variables, faults=None, supervisor=None):
+    import types
+
+    from unionml_tpu.serving import build_aiohttp_app
+
+    stub = types.SimpleNamespace(name="chaos-app", artifact=object())
+    return build_aiohttp_app(
+        stub, resident=False, coalesce=False,
+        generator=lambda: _engine(model, variables, faults=faults),
+        generate_supervisor=supervisor,
+        generate_drain_s=2.0,
+    )
+
+
+def test_healthz_stats_and_recovery_over_http(gpt):
+    """The full HTTP contract of a supervised, fault-injected app: a request
+    that hits an engine failure mid-decode still returns 200 with the exact
+    fault-free tokens; /healthz serves the state machine (503 while
+    rebuilding/failed); /stats carries the generation.robustness block."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    model, variables = gpt
+    expected = _engine(model, variables).generate(PROMPT_A, 8)
+    sup = _supervisor()
+    app = _app(model, variables, faults=FaultPlan(step_dispatch_failures=(3,)), supervisor=sup)
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/healthz")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["state"] == "ok" and body["supervised"] is True
+
+            resp = await client.post(
+                "/generate", json={"prompt_ids": PROMPT_A, "max_new_tokens": 8}
+            )
+            assert resp.status == 200, await resp.text()
+            assert (await resp.json())["tokens"] == expected
+
+            stats = await (await client.get("/stats")).json()
+            block = stats["generation"]["robustness"]
+            assert block["health"] == "ok"
+            assert block["engine_failures"] == 1 and block["rebuilds"] >= 1
+            assert block["recovered_requests"] >= 1
+            assert block["faults"]["injected"]["step_dispatch"] == 1
+
+            # the health route serves the 503 side of the contract directly
+            with sup._lock:
+                sup._state = "rebuilding"
+            resp = await client.get("/healthz")
+            assert resp.status == 503
+            assert (await resp.json())["state"] == "rebuilding"
+            with sup._lock:
+                sup._state = "ok"
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_healthz_without_supervisor_reports_unsupervised(gpt):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    model, variables = gpt
+    app = _app(model, variables, supervisor=False)
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body = await (await client.get("/healthz")).json()
+            assert body == {"state": "ok", "supervised": False, "last_fault": None}
+            gen = app["continuous_batcher"]
+            assert gen.supervisor is None  # False really disabled supervision
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_drain_finishes_inflight_then_refuses_new_work(gpt):
+    """Graceful shutdown: drain() lets a decoding request finish exactly
+    while NEW submissions fail fast with the structured batcher_closed
+    reason — then the batcher is fully closed."""
+    model, variables = gpt
+    expected = _engine(model, variables).generate(PROMPT_A, BUDGET_A)
+    engine = _engine(model, variables)
+    batcher = ContinuousBatcher(engine, supervisor=_supervisor())
+
+    async def main():
+        fut = asyncio.ensure_future(batcher.generate(PROMPT_A, BUDGET_A))
+        while not engine.num_active:
+            await asyncio.sleep(0.005)
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, batcher.drain, 10.0)
+        with pytest.raises(EngineFailure) as err:
+            await batcher.generate(PROMPT_B, 4)
+        assert err.value.reason == "batcher_closed"
+        return await fut
+
+    assert asyncio.run(main()) == expected
+    _assert_no_pins_or_refs(engine)
+
+
+# ----------------------------------------------------------- salvage hygiene
+
+
+def test_take_salvage_transfers_pin_ownership(gpt):
+    """take_salvage hands the pins to the collector; releasing via
+    release_preempted drops them — and a second failure cannot double-free."""
+    from unionml_tpu.serving.continuous import PreemptedSlot
+
+    model, variables = gpt
+    plan = FaultPlan(step_dispatch_failures=(2,))
+    engine = _engine(model, variables, faults=plan)
+    engine.add_request(list(range(1, 10)), 8)
+    engine.step()
+    with pytest.raises(FaultError):
+        engine.step()
+    salvage = engine.take_salvage()
+    assert len(salvage) == 1 and salvage[0].tokens
+    assert engine.take_salvage() == []  # single collection
+    for rec in salvage:
+        engine.release_preempted(PreemptedSlot(tokens=rec.tokens, path=rec.path))
+    _assert_no_pins_or_refs(engine)

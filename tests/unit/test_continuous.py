@@ -238,7 +238,7 @@ def test_generate_route_over_http(gpt):
 
             resp = await client.post("/generate", json={})
             assert resp.status == 400
-            assert (await resp.json())["reason"] == "invalid_request"
+            assert (await resp.json())["error"]["reason"] == "invalid_request"
 
             resp = await client.post(
                 "/generate", json={"prompt_ids": list(range(100)), "max_new_tokens": 4}

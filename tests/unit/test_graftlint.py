@@ -157,6 +157,88 @@ def test_clean_fixture_is_finding_free(tmp_path):
     assert not result.suppressed
 
 
+SWALLOWED_REPRO = '''
+def silent_pass():
+    try:
+        work()
+    except Exception:
+        pass
+
+def silent_bare():
+    try:
+        work()
+    except:
+        return None
+
+def silent_sentinel():
+    try:
+        return probe()
+    except Exception:
+        return False
+'''
+
+SWALLOWED_CLEAN = '''
+import logging
+logger = logging.getLogger(__name__)
+
+def reraises():
+    try:
+        work()
+    except Exception:
+        raise
+
+def wraps():
+    try:
+        work()
+    except Exception as exc:
+        raise RuntimeError(f"work failed: {exc}")
+
+def logs():
+    try:
+        work()
+    except Exception:
+        logger.exception("work failed")
+
+def records(sink):
+    try:
+        work()
+    except Exception as exc:
+        sink.fail(exc)
+
+def narrow_is_deliberate():
+    try:
+        return int(probe())
+    except (ValueError, TypeError):
+        return 0
+'''
+
+
+def test_swallowed_exception_repro_fires(tmp_path):
+    result = _lint_source(tmp_path, "sw", SWALLOWED_REPRO)
+    assert {f.rule for f in result.findings} == {"swallowed-exception"}
+    assert len(result.findings) == 3
+    assert {f.symbol for f in result.findings} == {
+        "silent_pass", "silent_bare", "silent_sentinel",
+    }
+    assert any("bare except" in f.message for f in result.findings)
+
+
+def test_swallowed_exception_accepts_reraise_log_and_record(tmp_path):
+    result = _lint_source(tmp_path, "swc", SWALLOWED_CLEAN)
+    assert result.ok, [f.format() for f in result.findings]
+
+
+def test_swallowed_exception_suppression_with_reason(tmp_path):
+    source = SWALLOWED_REPRO.replace(
+        "    except Exception:\n        pass",
+        "    except Exception:  # graftlint: disable=swallowed-exception -- fixture: best-effort probe\n        pass",
+    )
+    result = _lint_source(tmp_path, "sws", source)
+    assert {f.symbol for f in result.findings} == {"silent_bare", "silent_sentinel"}
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].rule == "swallowed-exception"
+
+
 # -------------------------------------------------------------- suppressions
 
 

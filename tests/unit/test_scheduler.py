@@ -543,10 +543,12 @@ def test_http_status_codes_and_reasons(gpt):
             ):
                 resp = await client.post("/generate", json=payload)
                 assert resp.status == 400, (payload, await resp.text())
-                body = await resp.json()
+                body = (await resp.json())["error"]
+                assert body["code"] == 400
                 assert body["reason"] in ("invalid_request", "invalid_json"), body
             resp = await client.post("/generate", data=b"not json")
-            assert resp.status == 400 and (await resp.json())["reason"] == "invalid_json"
+            assert resp.status == 400
+            assert (await resp.json())["error"]["reason"] == "invalid_json"
 
             gen = app["continuous_batcher"]
             engine = gen.engine
@@ -567,8 +569,12 @@ def test_http_status_codes_and_reasons(gpt):
                 "/generate", json={"prompt_ids": [5, 5], "max_new_tokens": 4}
             )
             assert resp.status == 429, await resp.text()
-            assert (await resp.json())["reason"] == "queue_full"
+            body = (await resp.json())["error"]
+            assert body["reason"] == "queue_full" and body["code"] == 429
+            # jittered retry advice: ±25% around the configured 1s, in BOTH
+            # the header and the machine-readable envelope
             assert "Retry-After" in resp.headers
+            assert 750 <= body["retry_after_ms"] <= 1250
 
             assert (await hog).status == 200
             assert (await filler).status == 200
@@ -592,7 +598,7 @@ def test_http_status_codes_and_reasons(gpt):
                 json={"prompt_ids": [4, 4], "max_new_tokens": 4, "deadline_ms": 25},
             )
             assert resp.status == 504, await resp.text()
-            assert (await resp.json())["reason"] == "deadline_exceeded"
+            assert (await resp.json())["error"]["reason"] == "deadline_exceeded"
             assert (await hog2).status == 200
 
             # --- 503: observed queueing makes the deadline infeasible
@@ -603,7 +609,7 @@ def test_http_status_codes_and_reasons(gpt):
                 json={"prompt_ids": [1, 2], "max_new_tokens": 4, "deadline_ms": 50},
             )
             assert resp.status == 503, await resp.text()
-            assert (await resp.json())["reason"] == "deadline_infeasible"
+            assert (await resp.json())["error"]["reason"] == "deadline_infeasible"
             assert "Retry-After" in resp.headers
             with gen.scheduler._lock:
                 gen.scheduler.queue_wait_ema_ms = None

@@ -95,6 +95,10 @@ run serving_pipeline 300 python bench_serving.py --pipeline ab
 # SLO scheduler A/B: mixed interactive+batch load, scheduler vs FIFO —
 # per-class TTFT p50/p95/p99 + shed/preempt/deadline-miss counts
 run serving_slo 300 python bench_serving.py --slo-mix
+# chaos smoke: injected engine failure + NaN slot mid-flood through the
+# supervised batcher — recovery latency, recovered-token parity (the phase
+# exits nonzero on a parity miss or a pinned-block leak, failing the step)
+run serving_chaos 300 python bench_serving.py --chaos
 # most expensive phase last: ~1.3B-param decode, bf16 vs int8 weight-only
 run int8 600 python bench_int8.py
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log

@@ -336,6 +336,7 @@ def _load_rule_modules() -> None:
         rules_async,
         rules_deadlock,
         rules_donation,
+        rules_exceptions,
         rules_host_sync,
         rules_locks,
         rules_retrace,

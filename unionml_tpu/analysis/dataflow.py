@@ -473,7 +473,7 @@ def donated_arg_exprs(call: ast.Call, positions: Sequence[int]) -> List[Tuple[st
         if isinstance(arg, (ast.Name, ast.Attribute, ast.Subscript)):
             try:
                 out.append((ast.unparse(arg), arg))
-            except Exception:  # pragma: no cover - unparse is total on these
+            except ValueError:  # pragma: no cover - unparse is total on these
                 continue
     return out
 

@@ -187,7 +187,7 @@ class _FunctionWalk:
                 continue
             try:
                 key = ast.unparse(node)
-            except Exception:  # pragma: no cover
+            except ValueError:  # pragma: no cover
                 continue
             taint = self.tainted.get(key)
             if taint is None:
@@ -213,7 +213,7 @@ class _FunctionWalk:
                 t = t.value
             try:
                 key = ast.unparse(t)
-            except Exception:  # pragma: no cover
+            except ValueError:  # pragma: no cover
                 continue
             self.tainted.pop(key, None)
             self.local_factories.pop(key, None)

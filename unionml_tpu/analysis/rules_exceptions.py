@@ -1,0 +1,125 @@
+"""Rule ``swallowed-exception``: broad handlers that bury the error.
+
+The serving core's failure taxonomy (ISSUE 7) only works if failures actually
+REACH it: a ``except Exception: pass`` between a device fault and the
+supervisor turns a recoverable incident into a silent wedge — the request
+hangs, the health state stays green, and the only evidence is a missing
+response. This rule mechanically forbids that shape: every broad handler
+(``except Exception:``, ``except BaseException:``, or a bare ``except:``)
+must do at least one of
+
+- **re-raise** — a ``raise`` anywhere in the handler body (plain or a new,
+  typically structured, exception);
+- **log** — a call whose method name is a logging verb (``debug``/``info``/
+  ``warning``/``error``/``exception``/``critical``/``log``);
+- **record** — *use the bound exception* (``except Exception as exc:`` with
+  ``exc`` read somewhere in the body): passing it to a sink/callback,
+  embedding it in a structured response or message, stashing it on state.
+
+A handler that intentionally does none of these (a best-effort ``__del__``,
+an optional-probe fallback) needs the standard reasoned suppression —
+``# graftlint: disable=swallowed-exception -- why silence is safe here`` — so
+every silenced failure path documents its justification in the diff.
+
+Narrow handlers (``except ValueError:`` etc.) are exempt: naming the expected
+exception is itself the evidence that the swallow is deliberate and bounded.
+"""
+
+import ast
+from typing import Iterator, List
+
+from unionml_tpu.analysis.core import Finding, Project, register
+
+#: method names that count as logging the failure
+LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "error", "exception", "critical", "log"}
+)
+#: exception types broad enough to catch arbitrary failures
+BROAD_TYPES = frozenset({"Exception", "BaseException"})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    node = handler.type
+    if node is None:
+        return True  # bare except:
+    if isinstance(node, ast.Name):
+        return node.id in BROAD_TYPES
+    if isinstance(node, ast.Attribute):
+        return node.attr in BROAD_TYPES
+    if isinstance(node, ast.Tuple):
+        return any(
+            _is_broad(ast.ExceptHandler(type=el, name=None, body=[])) for el in node.elts
+        )
+    return False
+
+
+def _handles_failure(handler: ast.ExceptHandler) -> bool:
+    """Whether the handler re-raises, logs, or uses the bound exception."""
+    bound = handler.name
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in LOG_METHODS
+        ):
+            return True
+        if (
+            bound
+            and isinstance(node, ast.Name)
+            and node.id == bound
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    """Collects offending handlers with their enclosing symbol qualname."""
+
+    def __init__(self) -> None:
+        self.stack: List[str] = []
+        self.found: List = []  # (handler, qualname)
+
+    def _visit_scope(self, node: ast.AST, name: str) -> None:
+        self.stack.append(name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_scope(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_scope(node, node.name)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._visit_scope(node, node.name)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if _is_broad(node) and not _handles_failure(node):
+            self.found.append((node, ".".join(self.stack)))
+        self.generic_visit(node)
+
+
+@register(
+    "swallowed-exception",
+    "broad except handlers that neither re-raise, log, nor record the failure",
+)
+def check(project: Project) -> Iterator[Finding]:
+    for mod in project.modules:
+        visitor = _Visitor()
+        visitor.visit(mod.tree)
+        for handler, symbol in visitor.found:
+            what = "bare except" if handler.type is None else "broad except"
+            yield Finding(
+                "swallowed-exception",
+                mod.relpath,
+                handler.lineno,
+                handler.col_offset,
+                f"{what} swallows the error: the handler neither re-raises, "
+                f"logs, nor records the exception — a failure here vanishes "
+                f"without a trace; narrow the except, handle the failure, or "
+                f"suppress with a reason",
+                symbol=symbol,
+            )

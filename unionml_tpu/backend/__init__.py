@@ -724,7 +724,7 @@ def wire_encode_value(value: Any, hyperparameters: Any = None) -> Any:
     try:
         pickle.dumps(value)
         return value
-    except Exception:
+    except Exception:  # graftlint: disable=swallowed-exception -- a picklability PROBE: any failure routes the value to state_encode(), which is the handling
         return state_encode()
 
 

@@ -91,7 +91,7 @@ class StorePath:
     def is_dir(self) -> bool:
         try:
             return bool(self._fs.isdir(self._path))
-        except Exception:
+        except Exception:  # graftlint: disable=swallowed-exception -- fsspec backends raise wildly varied errors for missing paths; "not a dir" is the correct total answer
             return False
 
     def iterdir(self) -> Iterator["StorePath"]:

@@ -4,9 +4,11 @@ from typing import Any, Optional
 
 from unionml_tpu.serving.app import build_aiohttp_app, jsonable, load_model_artifact, run_app
 from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+from unionml_tpu.serving.faults import EngineFailure, FaultError, FaultPlan
 from unionml_tpu.serving.prefix_cache import PrefixCache
 from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
 from unionml_tpu.serving.speculative import SpeculativeBatcher
+from unionml_tpu.serving.supervisor import EngineSupervisor
 from unionml_tpu.serving.resident import ResidentPredictor
 
 
@@ -60,6 +62,10 @@ def serving_app(
 __all__ = [
     "ContinuousBatcher",
     "DecodeEngine",
+    "EngineFailure",
+    "EngineSupervisor",
+    "FaultError",
+    "FaultPlan",
     "PrefixCache",
     "ResidentPredictor",
     "SLOScheduler",

@@ -92,6 +92,8 @@ def build_aiohttp_app(
     generate_prefix_cache_blocks: int = 0,
     generate_prefix_block_size: int = 16,
     generate_scheduler: Optional[Any] = None,
+    generate_supervisor: Optional[Any] = None,
+    generate_drain_s: float = 5.0,
     mesh: Optional[Any] = None,
     param_specs: Optional[Any] = None,
 ):
@@ -137,8 +139,26 @@ def build_aiohttp_app(
     policy). ``/generate`` payloads may carry ``priority``
     (``interactive``/``standard``/``batch``) and ``deadline_ms``; overload
     sheds map to HTTP 429/503 with ``Retry-After``, deadline expiry to 504,
-    invalid requests to 400 — each with a machine-readable ``reason`` — and
-    scheduler counters surface under ``GET /stats`` → ``generation.scheduler``.
+    invalid requests to 400 — every error response shares ONE machine-readable
+    envelope, ``{"error": {"code", "reason", "detail", "retry_after_ms"?}}``
+    (``retry_after_ms`` is jittered so shed clients never retry in lockstep) —
+    and scheduler counters surface under ``GET /stats`` →
+    ``generation.scheduler``.
+
+    ``generate_supervisor`` configures engine supervision when the app wraps a
+    bare engine: ``None`` (default) builds an
+    :class:`~unionml_tpu.serving.supervisor.EngineSupervisor` — engine
+    failures salvage and RESUME every recoverable request token-identically,
+    NaN-logits quarantine per request, a watchdog flags fetch stalls, and
+    ``GET /healthz`` serves the health state machine (200 while
+    ``ok``/``degraded``, 503 while ``rebuilding``/``failed``, with the last
+    fault's reason). Pass a prebuilt supervisor to tune its knobs, or
+    ``False`` to disable supervision. Shutdown drains gracefully: new
+    submissions fail fast while in-flight work finishes for up to
+    ``generate_drain_s`` seconds before the batcher closes. Robustness
+    counters (faults injected/observed, rebuilds, recovered vs failed
+    requests, quarantines, watchdog trips) surface under ``GET /stats`` →
+    ``generation.robustness``.
     """
     from aiohttp import web
 
@@ -181,6 +201,7 @@ def build_aiohttp_app(
             predictor.setup()
         if generator is not None:
             from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+            from unionml_tpu.serving.supervisor import EngineSupervisor
 
             built = generator() if callable(generator) and not isinstance(
                 generator, (DecodeEngine, ContinuousBatcher)
@@ -192,8 +213,16 @@ def build_aiohttp_app(
                         generate_prefix_cache_blocks, generate_prefix_block_size
                     )
             if isinstance(built, DecodeEngine):
+                # supervision is ON by default for app-owned batchers: engine
+                # failures recover instead of failing the house (False opts out)
+                supervisor = generate_supervisor
+                if supervisor is None:
+                    supervisor = EngineSupervisor()
+                elif supervisor is False:
+                    supervisor = None
                 built = ContinuousBatcher(
-                    built, lookahead=generate_lookahead, scheduler=generate_scheduler
+                    built, lookahead=generate_lookahead, scheduler=generate_scheduler,
+                    supervisor=supervisor,
                 )
             app["continuous_batcher"] = built
         logger.info("Serving app ready (model=%s).", model.name)
@@ -201,8 +230,17 @@ def build_aiohttp_app(
     async def on_cleanup(app):
         if batcher is not None:
             batcher.close()
-        if app.get("continuous_batcher") is not None:
-            app["continuous_batcher"].close()
+        gen = app.get("continuous_batcher")
+        if gen is not None:
+            # graceful drain: stop admitting, let in-flight work finish (or
+            # time out into prompt structured failures), then close
+            drain = getattr(gen, "drain", None)
+            if callable(drain):
+                # graftlint: disable=async-blocking -- shutdown hook: the server already stopped accepting; blocking the (dying) loop for the bounded drain is the point
+                drain(generate_drain_s)
+            else:
+                # graftlint: disable=async-blocking -- shutdown hook, same contract as drain above
+                gen.close()
 
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
@@ -215,11 +253,33 @@ def build_aiohttp_app(
             return web.json_response({"detail": "Model artifact not found."}, status=500)
         return web.json_response({"message": HTTPStatus.OK.phrase, "status": HTTPStatus.OK.value})
 
+    async def healthz(request):
+        """Load-balancer health: the supervisor's state machine, 503 while the
+        engine cannot serve (``rebuilding``/``failed``) so a router drains
+        this replica instead of timing out against it. Apps without a
+        supervised generator report on the model artifact alone."""
+        gen = request.app.get("continuous_batcher")
+        sup = getattr(gen, "supervisor", None) if gen is not None else None
+        if sup is None:
+            state = "ok" if model.artifact is not None else "failed"
+            body = {"state": state, "supervised": False, "last_fault": None}
+        else:
+            stats = sup.stats()
+            body = {
+                "state": stats["health"],
+                "supervised": True,
+                "last_fault": sup.last_fault,
+                "watchdog_trips": stats["watchdog_trips"],
+                "rebuilds": stats["rebuilds"],
+            }
+        serving = body["state"] in ("ok", "degraded")
+        return web.json_response(body, status=200 if serving else 503)
+
     async def predict(request):
         try:
             payload = await request.json()
-        except Exception:
-            return web.json_response({"detail": "Request body must be JSON."}, status=422)
+        except Exception as exc:
+            return web.json_response({"detail": f"Request body must be JSON: {exc}"}, status=422)
         inputs = payload.get("inputs")
         features = payload.get("features")
         if inputs is None and features is None:
@@ -260,15 +320,36 @@ def build_aiohttp_app(
             logger.exception("Prediction failed")
             return web.json_response({"detail": f"Prediction failed: {exc}"}, status=500)
 
+    def _error_response(status, reason, detail, retry_after_s=None):
+        """The ONE machine-readable error envelope every non-200 on this app
+        uses — 400/429/500/503/504 all share it, so clients parse one shape:
+
+            {"error": {"code": int, "reason": slug, "detail": str,
+                       "retry_after_ms": int?}}
+
+        ``retry_after_ms`` (and the ``Retry-After`` header) carry ±25% JITTER:
+        a shed wave handed one exact retry delay would come back as a
+        synchronized thundering herd — the spread de-correlates the retries.
+        """
+        import random
+
+        error = {"code": int(status), "reason": reason, "detail": detail}
+        headers = {}
+        if retry_after_s:
+            jittered = float(retry_after_s) * (0.75 + 0.5 * random.random())
+            error["retry_after_ms"] = int(jittered * 1000)
+            headers["Retry-After"] = str(max(1, round(jittered)))
+        return web.json_response({"error": error}, status=status, headers=headers)
+
     def _bad_request(detail, reason="invalid_request"):
         """Client-side rejection: machine-readable ``reason`` + human detail."""
-        return web.json_response({"detail": detail, "reason": reason}, status=400)
+        return _error_response(400, reason, detail)
 
     def _scheduling_response(exc):
         """Map a structured scheduling rejection to its HTTP contract:
         queue-full sheds are 429, infeasible-deadline sheds are 503 (both with
-        ``Retry-After``), and deadline expiry is 504 — each carrying the
-        error's machine-readable ``reason`` so clients can branch without
+        jittered ``Retry-After``), and deadline expiry is 504 — each carrying
+        the error's machine-readable ``reason`` so clients can branch without
         parsing prose."""
         from unionml_tpu.serving.scheduler import (
             DeadlineExceededError,
@@ -284,26 +365,33 @@ def build_aiohttp_app(
             status = 504
         else:
             status = 500
-        headers = {}
-        retry_after = getattr(exc, "retry_after_s", None)
-        if retry_after:
-            headers["Retry-After"] = str(max(1, int(round(retry_after))))
-        return web.json_response(
-            {"detail": str(exc), "reason": getattr(exc, "reason", "scheduling")},
-            status=status,
-            headers=headers,
+        return _error_response(
+            status, getattr(exc, "reason", "scheduling"), str(exc),
+            retry_after_s=getattr(exc, "retry_after_s", None),
+        )
+
+    def _engine_failure_response(exc):
+        """An engine-side structured failure: 503 when a retry can plausibly
+        succeed (rebuilding, transient fault — another replica, or this one in
+        a moment), 500 when it cannot — either way the reason slug travels,
+        never a generic stringified 500."""
+        retryable = bool(getattr(exc, "retryable", False))
+        return _error_response(
+            503 if retryable else 500, getattr(exc, "reason", "engine_failure"), str(exc),
+            retry_after_s=1.0 if retryable else None,
         )
 
     async def generate_route(request):
+        from unionml_tpu.serving.faults import EngineFailure
         from unionml_tpu.serving.scheduler import SchedulingError, parse_priority
 
         gen = request.app.get("continuous_batcher")
         if gen is None:
-            return web.json_response({"detail": "Generation is not enabled on this app."}, status=404)
+            return _error_response(404, "not_enabled", "Generation is not enabled on this app.")
         try:
             payload = await request.json()
-        except Exception:
-            return _bad_request("Request body must be JSON.", reason="invalid_json")
+        except Exception as exc:
+            return _bad_request(f"Request body must be JSON: {exc}", reason="invalid_json")
         prompt_ids = payload.get("prompt_ids")
         prompts = payload.get("prompts")
         if prompt_ids is None and prompts is None:
@@ -393,13 +481,16 @@ def build_aiohttp_app(
             except SchedulingError as exc:
                 await stream_it.aclose()
                 return _scheduling_response(exc)
+            except EngineFailure as exc:
+                await stream_it.aclose()
+                return _engine_failure_response(exc)
             except ValueError as exc:
                 await stream_it.aclose()
                 return _bad_request(str(exc))
             except Exception as exc:
                 await stream_it.aclose()
                 logger.exception("Generation failed")
-                return web.json_response({"detail": f"Generation failed: {exc}"}, status=500)
+                return _error_response(500, "internal", f"Generation failed: {exc}")
 
             # ndjson chunks: one {"token": N} line per decoded token, then a
             # {"done": true, "tokens": [...]} trailer. Failures from here on
@@ -426,17 +517,19 @@ def build_aiohttp_app(
             except Exception as exc:
                 logger.warning("Streaming generation ended early: %s", exc)
                 line = {"error": str(exc)}
-                if isinstance(exc, SchedulingError):
-                    # a deadline expiring mid-stream lands here: the status is
-                    # committed, so the reason slug travels in-band instead
-                    line["reason"] = exc.reason
+                reason = getattr(exc, "reason", None)
+                if reason is not None:
+                    # a deadline expiring (or the engine failing) mid-stream
+                    # lands here: the status is committed, so the reason slug
+                    # travels in-band instead
+                    line["reason"] = reason
                 try:  # the transport may be the thing that failed
                     await response.write((_json.dumps(line) + "\n").encode())
-                except Exception:
+                except Exception:  # graftlint: disable=swallowed-exception -- writing the in-band error line to a transport that may itself be the failure: nothing is left to tell
                     pass
             try:
                 await response.write_eof()
-            except Exception:
+            except Exception:  # graftlint: disable=swallowed-exception -- eof on a possibly-dead transport: the request is already finished either way
                 pass
             return response
         try:
@@ -449,11 +542,13 @@ def build_aiohttp_app(
             return web.json_response({"completions": list(completions)})
         except SchedulingError as exc:  # structured shed / deadline rejection
             return _scheduling_response(exc)
+        except EngineFailure as exc:  # engine-side structured failure (recovery taxonomy)
+            return _engine_failure_response(exc)
         except ValueError as exc:  # bad request (empty/oversized prompt, bad budget)
             return _bad_request(str(exc))
         except Exception as exc:  # engine/worker failures are SERVER errors
             logger.exception("Generation failed")
-            return web.json_response({"detail": f"Generation failed: {exc}"}, status=500)
+            return _error_response(500, "internal", f"Generation failed: {exc}")
 
     async def stats(request):
         payload = {"model": model.name, "resident": predictor is not None}
@@ -491,6 +586,17 @@ def build_aiohttp_app(
                 # queue-wait EMA, shed / preemption / deadline-miss counters —
                 # the same block whichever generator kind is plugged in
                 payload["generation"]["scheduler"] = sched.stats()
+            # robustness observability: engine-side failure/quarantine/fault
+            # counters merged with the supervisor's health + recovery counters
+            robustness = {}
+            engine_stats = getattr(gen.engine, "robustness_stats", None)
+            if callable(engine_stats):
+                robustness.update(engine_stats())
+            sup = getattr(gen, "supervisor", None)
+            if sup is not None and callable(getattr(sup, "stats", None)):
+                robustness.update(sup.stats())
+            if robustness:
+                payload["generation"]["robustness"] = robustness
         if batcher is not None:
             payload["coalescing"] = dict(batcher.stats)
             if batcher.ema_gap_ms is not None:
@@ -499,6 +605,7 @@ def build_aiohttp_app(
 
     app.router.add_get("/", index)
     app.router.add_get("/health", health)
+    app.router.add_get("/healthz", healthz)
     app.router.add_get("/stats", stats)
     app.router.add_post("/predict", predict)
     app.router.add_post("/generate", generate_route)

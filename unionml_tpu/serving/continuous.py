@@ -62,6 +62,20 @@ structured errors, deadline enforcement on queued and running requests, and
 preempt-to-prefix-cache (:meth:`DecodeEngine.preempt`) that checkpoints a
 low-priority victim's KV into the PR-2 radix cache so a higher-priority
 arrival gets its slot and the victim resumes for one suffix prefill.
+
+FAULT TOLERANCE (ISSUE 7): the engine fails *well*. A device-side failure
+captures every live slot's salvage (host transcript + pinned radix path),
+rebuilds the device state in place from host-retained params — with PRNG
+continuity, so resumed sampled streams stay bit-identical — and a supervised
+batcher (:mod:`unionml_tpu.serving.supervisor`) re-queues every salvageable
+request to resume token-identically, paying only a suffix prefill over its
+pinned blocks. NaN/Inf logits quarantine the one poisoned slot (an in-program
+finiteness flag rides the fused token fetch) instead of failing the batch; a
+single request's prefill death rolls admission back atomically and fails only
+that request. Every failure a consumer sees is a structured
+:class:`~unionml_tpu.serving.faults.EngineFailure` with a machine-readable
+reason, and all of it is deterministically injectable via
+:class:`~unionml_tpu.serving.faults.FaultPlan` (see ``tests/unit/test_chaos.py``).
 """
 
 import asyncio
@@ -75,6 +89,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from unionml_tpu._logging import logger
+from unionml_tpu.serving.faults import EngineFailure, FaultPlan
 
 #: default prompt-prefill bucket lengths (right-padded; one XLA compile each)
 DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512)
@@ -94,6 +109,11 @@ class StepEvent:
     #: into queue wait vs prefill+decode (None on every later event, and for
     #: requests admitted without a queue, e.g. direct ``add_request`` calls)
     queue_wait_ms: Optional[float] = None
+    #: machine-readable failure slug when the ENGINE terminated this request
+    #: (``nan_logits`` quarantine, ``prefill_failed`` chunked-prefill death):
+    #: the event carries no token (``emit=False``, ``finished=True``) and the
+    #: consumer must fail, not finish, the request
+    error: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -109,6 +129,26 @@ class PreemptedSlot:
 
     tokens: List[int]
     path: List[Any]
+
+
+@dataclasses.dataclass
+class SalvagedSlot:
+    """One slot's resumable state captured at engine-failure time.
+
+    Unlike :class:`PreemptedSlot` (a deliberate checkpoint that device-copies
+    the transcript's KV into the pool first), salvage is captured while the
+    device state may be POISONED, so it is host-only: ``tokens`` is the slot's
+    replayed transcript (prompt + every token already delivered), ``path`` is
+    whatever radix-tree chain the slot already held — pinned, it survives the
+    rebuild and shrinks the resume to a suffix prefill — and ``remaining`` is
+    the slot's unspent token budget. The collector must eventually unpin
+    ``path`` (:meth:`DecodeEngine.release_preempted` accepts the same shape).
+    """
+
+    slot: int
+    tokens: List[int]
+    path: List[Any]
+    remaining: int
 
 
 class DecodeEngine:
@@ -164,6 +204,10 @@ class DecodeEngine:
         ``pipeline=False`` (events are simply delivered one tick later).
         ``cancel``/``abort_all``/``reset`` flush or discard the in-flight
         step, so no stale token is ever applied to a reused slot.
+    :param faults: a :class:`~unionml_tpu.serving.faults.FaultPlan` arming
+        deterministic fault injection (chaos tests and ``bench_serving
+        --chaos`` only). ``None`` (production) makes every hook a single host
+        branch — no device work, no host syncs added to the hot path.
     """
 
     def __init__(
@@ -185,6 +229,7 @@ class DecodeEngine:
         prefix_block_size: int = 16,
         prefix_cache_generated: bool = False,
         pipeline: bool = True,
+        faults: Optional[FaultPlan] = None,
     ) -> None:
         from unionml_tpu.models.gpt import init_cache
 
@@ -246,6 +291,36 @@ class DecodeEngine:
         self._seed = seed
         self._resets = 0
 
+        #: deterministic fault-injection script (None in production: every
+        #: hook is a single host ``is not None`` branch — zero device work)
+        self._faults = faults
+        #: engine-failure incidents survived (the batcher keys recovery off a
+        #: delta of this counter, like the old ``_resets`` check but precise)
+        self.failure_count = 0
+        #: device-state rebuilds performed (in-place recovery + supervised)
+        self.rebuilds = 0
+        #: requests terminated by per-slot NaN/Inf-logits quarantine
+        self.quarantined_requests = 0
+        #: salvage captured at the last failure, awaiting :meth:`take_salvage`
+        self._salvage: List[SalvagedSlot] = []
+        #: set when an in-place rebuild itself failed: the engine refuses work
+        #: until :meth:`rebuild` succeeds (the supervisor retries with backoff;
+        #: unsupervised callers retry lazily via ``_ensure_usable``)
+        self._failed = False
+        #: set by a donating dispatch that raised (its donated engine state is
+        #: poisoned); the public entry points escalate to a full failure
+        self._device_poisoned = False
+        #: key-consuming steps replayed since the key's base was (re)seeded —
+        #: lets a resume-rebuild reconstruct the PRNG stream so recovered
+        #: sampled requests stay token-identical to a fault-free run
+        self._key_steps = 0
+        #: liveness timestamp (monotonic) the supervisor's watchdog reads:
+        #: refreshed at every step dispatch and token-fetch completion
+        self.last_heartbeat = time.monotonic()
+        #: slots admitted by the admit_many call in progress (rollback set for
+        #: its atomic non-poisoning unwind); None outside admission
+        self._admitting: Optional[List[int]] = None
+
         # host mirrors (authoritative for scheduling; device arrays follow them)
         self._active = np.zeros(num_slots, dtype=bool)
         #: slots holding an in-progress chunked prefill: not active (no decode
@@ -271,9 +346,16 @@ class DecodeEngine:
 
         #: depth-1 pipelining: dispatch step N+1 before fetching step N's tokens
         self.pipeline = bool(pipeline)
-        #: the dispatched-but-unfetched step: ``(tokens, masks, n_steps)`` device
-        #: arrays (leading axis = steps in the burst), or None when drained
-        self._inflight: Optional[Tuple[Any, Any, int]] = None
+        #: the dispatched-but-unfetched step: ``(tokens, masks, bads, n_steps)``
+        #: device arrays (leading axis = steps in the burst), or None when drained
+        self._inflight: Optional[Tuple[Any, Any, Any, int]] = None
+        #: slots QUARANTINED while ``_inflight`` was already dispatched: that
+        #: burst still carries their (garbage) tokens under an active mask, so
+        #: its replay must skip them — the slot may hold a NEW occupant by
+        #: then, and crediting the stale token would corrupt its stream (the
+        #: same hazard cancel() avoids by flushing first, which a quarantine —
+        #: raised DURING a replay — cannot)
+        self._inflight_skip: set = set()
         #: events replayed by an out-of-band flush (cancel/admission), delivered
         #: by the next :meth:`step` so the batcher's fan-out sees every token
         self._pending_events: List[StepEvent] = []
@@ -337,6 +419,11 @@ class DecodeEngine:
             # masked step past full retirement, and sampled streams must stay
             # identical to an engine that (knowing the retirement) never ran it
             new_key = jnp.where(jnp.any(active), new_key, key)
+            # per-slot finiteness of the logits this step SAMPLES from: a
+            # NaN/Inf row (weight corruption, a NaN storm, injected poison)
+            # flags only its own slot, rides the fetch with tokens/masks, and
+            # quarantines that request host-side — siblings keep decoding
+            bad = ~jnp.all(jnp.isfinite(last_logits), axis=-1)
             if sampling:
                 tokens = sample_logits(last_logits, subkey, temp, top_k, top_p)
             else:
@@ -347,7 +434,7 @@ class DecodeEngine:
             # cache write lands on a column their own future prefill/decode rewrites
             new_lens = jnp.where(active, jnp.minimum(lens + 1, max_len - 1), lens)
             new_logits = jnp.where(active[:, None], logits[:, -1, :], last_logits)
-            return cache, new_logits, new_lens, tokens, new_key
+            return cache, new_logits, new_lens, tokens, new_key, bad
 
         def _make_step(n_steps: int, sampling: bool):
             """K decode steps fused into one device program (``lax.scan``;
@@ -366,7 +453,7 @@ class DecodeEngine:
             def _multi(variables, cache, last_logits, lens, active, remaining, key, temp, top_k, top_p):
                 def body(carry, _):
                     cache, last_logits, lens, active, remaining, key = carry
-                    cache, new_logits, new_lens, tokens, key = _decode_body(
+                    cache, new_logits, new_lens, tokens, key, bad = _decode_body(
                         variables, cache, last_logits, lens, active, key, temp, top_k, top_p,
                         sampling=sampling,
                     )
@@ -374,13 +461,13 @@ class DecodeEngine:
                         active, remaining, new_lens, tokens, max_len, eos_token_id
                     )
                     carry = (cache, new_logits, new_lens, new_active, new_remaining, key)
-                    return carry, (tokens, active)
+                    return carry, (tokens, active, bad)
 
                 carry = (cache, last_logits, lens, active, remaining, key)
-                (cache, last_logits, lens, active, remaining, key), (toks, masks) = jax.lax.scan(
+                (cache, last_logits, lens, active, remaining, key), (toks, masks, bads) = jax.lax.scan(
                     body, carry, None, length=n_steps
                 )
-                return cache, last_logits, lens, active, remaining, key, toks, masks
+                return cache, last_logits, lens, active, remaining, key, toks, masks, bads
 
             return jax.jit(_multi, donate_argnums=(1, 2))
 
@@ -506,6 +593,7 @@ class DecodeEngine:
         self._active_dev, self._remaining_dev = active, remaining
         # any dispatched-but-unfetched step referenced the old buffers: dead now
         self._inflight = None
+        self._inflight_skip = set()
 
     def _sync_sampling_mirrors(self) -> None:
         """Refresh the device mirrors of the per-slot sampling controls from the
@@ -643,6 +731,8 @@ class DecodeEngine:
         self._slot_top_k[slot] = top_k
         self._slot_top_p[slot] = top_p
         self.requests_admitted += 1
+        if self._admitting is not None:
+            self._admitting.append(slot)
         self._slot_device_update(slot, True, budget, temp, top_k, top_p)
 
     def _slot_device_update(
@@ -660,17 +750,23 @@ class DecodeEngine:
             np.int32(min(int(budget), np.iinfo(np.int32).max)),
             np.float32(temp), np.int32(top_k), np.float32(top_p),
         ))
-        (
-            self._active_dev,
-            self._remaining_dev,
-            self._temp_dev,
-            self._top_k_dev,
-            self._top_p_dev,
-        ) = self._slot_update_fn(
-            self._active_dev, self._remaining_dev,
-            self._temp_dev, self._top_k_dev, self._top_p_dev,
-            *scalars,
-        )
+        try:
+            (
+                self._active_dev,
+                self._remaining_dev,
+                self._temp_dev,
+                self._top_k_dev,
+                self._top_p_dev,
+            ) = self._slot_update_fn(
+                self._active_dev, self._remaining_dev,
+                self._temp_dev, self._top_k_dev, self._top_p_dev,
+                *scalars,
+            )
+        except Exception:
+            # the point-update donates every slot mirror: a failure here left
+            # them consumed, which the public entry points escalate
+            self._device_poisoned = True
+            raise
 
     def add_request(
         self,
@@ -719,7 +815,40 @@ class DecodeEngine:
         token-block comparison) defers to a second pass and restores that KV
         instead of recomputing it — a cold burst of N same-prefix prompts pays
         ONE full prefill plus N-1 suffixes, not N full prefills.
+
+        Admission is ATOMIC against non-poisoning failures: when a prefill
+        dispatch dies without consuming shared engine state, every slot this
+        call already admitted is cancelled before the exception re-raises, so
+        the caller can attribute the failure per-request by re-admitting one
+        at a time (the batcher does exactly this). A failure that consumed
+        donated engine state escalates to a full engine failure instead —
+        salvage captured, device state rebuilt in place (see :meth:`rebuild`).
         """
+        self._ensure_usable()
+        if self._faults is not None:
+            self._faults.begin_admit()
+        failures_before = self.failure_count
+        self._admitting = []
+        try:
+            return self._admit_many_inner(requests)
+        except Exception:
+            if self.failure_count == failures_before:
+                if self._device_poisoned:
+                    # a donating dispatch died mid-admission: the shared
+                    # engine state is consumed, so this is a full failure
+                    self._on_failure()
+                else:
+                    # clean unwind: the engine (and every other request) is
+                    # intact — only this call's own admissions roll back
+                    for slot in list(self._admitting):
+                        self.cancel(slot)
+            raise
+        finally:
+            self._admitting = None
+            if self._faults is not None:
+                self._faults.end_admit()
+
+    def _admit_many_inner(self, requests: Sequence[Tuple]) -> List[int]:
         normalized = []
         for req in requests:
             prompt_ids, budget = req[0], req[1]
@@ -789,11 +918,13 @@ class DecodeEngine:
                     prompt = slot_to_norm[slot][0]
                     padded[r, : prompt.size] = prompt
                     lengths[r] = prompt.size
+                if self._faults is not None:
+                    self._faults.check_prefill()
                 local_cache, local_logits = self._prefill_fn(
                     self._variables, jnp.asarray(padded), jnp.asarray(lengths)
                 )
-                self._cache, self._lens, self._last_logits = self._insert_fn(
-                    self._cache, self._lens, self._last_logits, local_cache, local_logits,
+                self._insert_into_slots(
+                    local_cache, local_logits,
                     jnp.asarray(chunk, dtype=jnp.int32),
                     jnp.asarray(lengths),
                 )
@@ -880,18 +1011,27 @@ class DecodeEngine:
         self.prefix_restore_dispatches += 1
         ids = np.zeros((1, bucket), dtype=np.int32)
         ids[0, :suffix_len] = prompt[matched:]
-        logits, local_cache = self._chunk_fn(
-            self._variables, jax.device_put(ids), local_cache,
-            jax.device_put(np.int32(matched)),
-        )
-        self.prefill_dispatches += 1
-        self.prefill_tokens_computed += suffix_len
-        last = self._pick_last_fn(logits, jax.device_put(np.int32(suffix_len - 1)))
-        self._cache, self._lens, self._last_logits = self._insert_fn(
-            self._cache, self._lens, self._last_logits, local_cache, last,
-            jax.device_put(np.asarray([slot], dtype=np.int32)),
-            jax.device_put(np.asarray([prompt.size], dtype=np.int32)),
-        )
+        try:
+            if self._faults is not None:
+                self._faults.check_prefill()
+            logits, local_cache = self._chunk_fn(
+                self._variables, jax.device_put(ids), local_cache,
+                jax.device_put(np.int32(matched)),
+            )
+            self.prefill_dispatches += 1
+            self.prefill_tokens_computed += suffix_len
+            last = self._pick_last_fn(logits, jax.device_put(np.int32(suffix_len - 1)))
+            self._insert_into_slots(
+                local_cache, last,
+                jax.device_put(np.asarray([slot], dtype=np.int32)),
+                jax.device_put(np.asarray([prompt.size], dtype=np.int32)),
+            )
+        except Exception:
+            # whatever died, this request's matched-path references must not
+            # leak with it (the blocks stay indexed for future hits)
+            self.prefix_cache.release(path)
+            path.clear()
+            raise
         self.prefix_cache.record_hit(matched)
         self._activate(slot, int(prompt.size), budget, temp, top_k, top_p)
         self._slot_path[slot] = path
@@ -899,21 +1039,35 @@ class DecodeEngine:
         return True
 
     def _index_prompt(self, slot: int, prompt: np.ndarray) -> None:
-        """Index a freshly prefilled prompt's KV into the pool (all its full
-        blocks) and start the slot's token transcript when generated-KV capture
-        is on. Runs AFTER :meth:`_activate`, on every admission path."""
+        """Start the slot's token transcript and (cache on) index the prompt's
+        KV into the pool. Runs AFTER :meth:`_activate`, on every admission path.
+
+        The transcript serves generated-KV capture at retirement
+        (``prefix_cache_generated``), preempt-to-prefix-cache checkpointing,
+        AND failure salvage — the last works without the cache, so the
+        transcript is kept unconditionally (host ints: cost is trivial)."""
+        self._slot_tokens[slot] = [int(t) for t in prompt]
         if self.prefix_cache is None:
             return
-        # the transcript serves BOTH generated-KV capture at retirement
-        # (prefix_cache_generated) and preempt-to-prefix-cache checkpointing,
-        # so it is kept whenever the cache is on (host ints: cost is trivial)
-        self._slot_tokens[slot] = [int(t) for t in prompt]
         self._extend_index(slot, prompt)
 
     def _extend_index(self, slot: int, tokens: np.ndarray) -> None:
         """Extend the slot's held radix path over ``tokens``' full blocks and
-        device-copy KV for the NEW blocks out of the slot's cache rows."""
+        device-copy KV for the NEW blocks out of the slot's cache rows.
+
+        Caching failures never kill the request: an exhausted pool (every
+        block referenced — or injected) simply indexes nothing new, and a
+        failed block save (which donates, i.e. poisons, only the POOL) rebuilds
+        the pool in place and forgets every cached prefix — the slot cache,
+        and therefore the request, are untouched either way."""
         path = self._slot_path.pop(slot, [])
+        if self._faults is not None and self._faults.pool_exhausted():
+            # injected exhaustion: behave exactly like extend() against a
+            # fully-referenced pool — keep what is held, index nothing new
+            self._faults.note_observed("pool_exhausted")
+            if path:
+                self._slot_path[slot] = path
+            return
         full, new = self.prefix_cache.extend(
             path, tokens, int(tokens.size) // self._prefix_block_size
         )
@@ -922,13 +1076,35 @@ class DecodeEngine:
             # explicit uploads: block saves run at retirement, INSIDE the
             # steady-state step path the transfer guard disallows implicits on
             dst = jax.device_put(np.asarray([node.block_id for node in new], dtype=np.int32))
-            self._pool = self._save_fn(
-                self._pool, self._cache, jax.device_put(np.int32(slot)),
-                jax.device_put(np.int32(start)), dst, self._prefix_block_size,
-            )
+            try:
+                self._pool = self._save_fn(
+                    self._pool, self._cache, jax.device_put(np.int32(slot)),
+                    jax.device_put(np.int32(start)), dst, self._prefix_block_size,
+                )
+            except Exception as exc:
+                logger.warning(
+                    "prefix-cache block save failed (%s); rebuilding the pool in place", exc
+                )
+                self._rebuild_pool()
+                return
             self.prefix_save_dispatches += 1
         if full:
             self._slot_path[slot] = full
+
+    def _rebuild_pool(self) -> None:
+        """Reallocate the (poisoned or reset) KV block pool and forget every
+        cached prefix. Held node paths — other slots', pinned checkpoints' —
+        now reference orphaned nodes; their later release/unpin calls mutate
+        those orphans harmlessly, and re-admissions simply re-index."""
+        from unionml_tpu.models.gpt import init_block_pool
+
+        self.prefix_cache.clear()
+        self._slot_path.clear()
+        self._pool = init_block_pool(
+            self._config, self.prefix_cache.num_blocks, self._prefix_block_size
+        )
+        if self._mesh is not None:
+            self._pool = jax.device_put(self._pool, self._cache_sharding)
 
     def _capture_generated(self, slot: int) -> None:
         """At retirement (``prefix_cache_generated``): index the slot's FULL
@@ -981,6 +1157,8 @@ class DecodeEngine:
             if self._mesh is not None:
                 local_cache = jax.device_put(local_cache, self._cache_sharding)
         self._reserved[slot] = True
+        if self._admitting is not None:
+            self._admitting.append(slot)
         self._partials[slot] = {
             "prompt": prompt, "consumed": matched, "cache": local_cache,
             "budget": budget, "temp": temp, "top_k": top_k, "top_p": top_p,
@@ -989,7 +1167,14 @@ class DecodeEngine:
 
     def _advance_partials(self) -> None:  # graftlint: off-path (admission work, not steady-state decode)
         """Run ONE chunk of every in-progress chunked prefill (called per tick,
-        between decode dispatches); completed prefills insert + activate."""
+        between decode dispatches); completed prefills insert + activate.
+
+        A failure in a slot's OWN chunk dispatch (the chunk program donates
+        only that slot's local cache) kills only that request — the partial
+        is dropped and a structured ``prefill_failed`` event reaches its
+        consumer — while every other slot keeps prefilling and decoding. Only
+        the slot-insert dispatch (which donates the shared engine cache) can
+        escalate to a whole-engine failure."""
         for slot in list(self._partials):
             state = self._partials[slot]
             prompt, consumed = state["prompt"], state["consumed"]
@@ -997,10 +1182,17 @@ class DecodeEngine:
             take = min(chunk, prompt.size - consumed)
             ids = np.zeros((1, chunk), dtype=np.int32)
             ids[0, :take] = prompt[consumed : consumed + take]
-            logits, state["cache"] = self._chunk_fn(
-                self._variables, jnp.asarray(ids), state["cache"],
-                jnp.asarray(consumed, dtype=jnp.int32),
-            )
+            try:
+                if self._faults is not None:
+                    self._faults.check_prefill()
+                logits, state["cache"] = self._chunk_fn(
+                    self._variables, jnp.asarray(ids), state["cache"],
+                    jnp.asarray(consumed, dtype=jnp.int32),
+                )
+            except Exception as exc:  # this slot's local dispatch: fail it alone
+                logger.warning("chunked prefill failed for slot %d: %s", slot, exc)
+                self._fail_partial(slot)
+                continue
             self.prefill_dispatches += 1
             self.prefill_tokens_computed += int(take)
             state["consumed"] = consumed + take
@@ -1010,8 +1202,8 @@ class DecodeEngine:
             last = self._pick_last_fn(
                 logits, jax.device_put(np.int32(prompt.size - 1 - consumed))
             )
-            self._cache, self._lens, self._last_logits = self._insert_fn(
-                self._cache, self._lens, self._last_logits, state["cache"], last,
+            self._insert_into_slots(
+                state["cache"], last,
                 jnp.asarray([slot], dtype=jnp.int32),
                 jnp.asarray([prompt.size], dtype=jnp.int32),
             )
@@ -1020,6 +1212,32 @@ class DecodeEngine:
                 slot, prompt.size, state["budget"], state["temp"], state["top_k"], state["top_p"]
             )
             self._index_prompt(slot, prompt)
+
+    def _fail_partial(self, slot: int) -> None:
+        """Drop one in-progress chunked prefill whose own dispatch died: free
+        the slot, release its restored-prefix references, and buffer the
+        structured failure event for its consumer."""
+        self._partials.pop(slot, None)
+        self._reserved[slot] = False
+        self._slot_queue_wait.pop(slot, None)
+        self._release_prefix(slot)
+        self._pending_events.append(
+            StepEvent(slot=slot, token=-1, emit=False, finished=True, error="prefill_failed")
+        )
+
+    def _insert_into_slots(self, local_cache: Any, local_logits: Any, slots: Any, lengths: Any) -> None:
+        """Run the donating slot-insert dispatch. A failure here has CONSUMED
+        the shared engine cache/lens/logits, so it marks the device state
+        poisoned — the public entry point escalates to a full engine failure
+        instead of pretending the batch survived."""
+        try:
+            self._cache, self._lens, self._last_logits = self._insert_fn(
+                self._cache, self._lens, self._last_logits, local_cache, local_logits,
+                slots, lengths,
+            )
+        except Exception:
+            self._device_poisoned = True
+            raise
 
     def reset(self) -> None:  # graftlint: off-path (error recovery, not steady-state decode)
         """Reallocate device state and clear all slots.
@@ -1032,6 +1250,10 @@ class DecodeEngine:
         # the key is also a step output, so it is poisoned too; a fresh
         # reset-counted key keeps sampled streams from repeating the pre-crash run
         self._resets += 1
+        self._key_steps = 0
+        self.discard_salvage()
+        self._failed = False
+        self._device_poisoned = False
         # a dispatched-but-unfetched step is poisoned with the rest of the
         # device state: DISCARD it (never fetch), and drop its replayed events
         self._pending_events.clear()
@@ -1047,18 +1269,169 @@ class DecodeEngine:
         self._slot_top_p[:] = 1.0
         self._sync_sampling_mirrors()
         if self.prefix_cache is not None:
-            # the pool is donated by block saves, so a failed save can poison it
-            # just like the cache: reallocate and forget every cached prefix
-            from unionml_tpu.models.gpt import init_block_pool
+            # a full reset forgets every cached prefix too: the caller is
+            # abandoning everything, held paths included
+            self._rebuild_pool()
+        self._slot_tokens.clear()
 
-            self.prefix_cache.clear()
-            self._slot_path.clear()
-            self._slot_tokens.clear()
-            self._pool = init_block_pool(
-                self._config, self.prefix_cache.num_blocks, self._prefix_block_size
+    # ------------------------------------------------------ failure & recovery
+
+    @property
+    def busy(self) -> bool:
+        """Whether live requests should be making progress — the supervisor's
+        watchdog only treats a stale heartbeat as a stall while this is True.
+        Keyed on host-visible work (active slots, chunked prefills), NOT on
+        ``_inflight``: a trailing dispatched-but-unfetched masked step idles
+        harmlessly after the last slot retires and must not read as a stall."""
+        return bool(self._active.any()) or bool(self._partials)
+
+    @property
+    def failed(self) -> bool:
+        """True while an in-place rebuild has failed and not yet been retried
+        successfully — the engine refuses work (the supervisor retries
+        :meth:`rebuild` with backoff; unsupervised callers retry lazily)."""
+        return self._failed
+
+    def _ensure_usable(self) -> None:
+        if self._failed:
+            # unsupervised auto-recovery: retry the rebuild fresh-keyed (no
+            # resume — whoever could have collected the salvage never did)
+            self.rebuild(resume=False)
+
+    def note_external_failure(self) -> None:
+        """Escalate a poisoning failure raised from an out-of-band engine call
+        (``cancel``/``preempt`` point-updates): the owner calls this from its
+        catch-all so donated-state loss is never papered over. Idempotent —
+        a failure already handled by the entry-point wrappers is a no-op."""
+        if self._device_poisoned:
+            self._on_failure()
+
+    def _on_failure(self) -> None:  # graftlint: off-path (error recovery, not steady-state decode)
+        """A device-side failure consumed donated engine state: capture every
+        salvageable slot (host transcripts plus already-indexed radix paths,
+        PINNED against eviction), then rebuild the device state in place with
+        PRNG-stream continuity. The engine is immediately usable again; a
+        supervising batcher collects :meth:`take_salvage` and re-queues the
+        requests so they resume token-identically, paying only the prefill of
+        whatever their pinned prefix does not cover. If the rebuild itself
+        fails, the engine marks itself failed for the supervisor's
+        bounded-backoff retry loop."""
+        self.failure_count += 1
+        self._device_poisoned = False
+        # the in-flight step is poisoned with the rest: never fetch it (its
+        # steps re-decode after the resume, consuming the same key stream)
+        self._inflight = None
+        self._inflight_skip = set()
+        self._pending_events.clear()
+        self._capture_salvage()
+        try:
+            self.rebuild(resume=True)
+        except Exception:
+            self._failed = True
+            logger.exception("in-place engine rebuild failed; engine marked failed")
+
+    def _capture_salvage(self) -> None:
+        """Snapshot every active/reserved slot's resumable state — HOST data
+        only (the device may be poisoned): the replayed transcript, the
+        unspent budget, and whatever radix path the slot already held, pinned
+        so the blocks survive the rebuild and LRU until the resume."""
+        self.discard_salvage()  # a prior incident's uncollected records
+        records: List[SalvagedSlot] = []
+        for slot in np.flatnonzero(self._active | self._reserved):
+            slot = int(slot)
+            if self._reserved[slot]:
+                # chunked prefill in progress: nothing delivered yet — the
+                # resume is simply the original prompt at full budget
+                part = self._partials.get(slot)
+                tokens = [int(t) for t in part["prompt"]] if part else []
+                remaining = int(part["budget"]) if part else 0
+            else:
+                transcript = self._slot_tokens.get(slot) or []
+                valid = int(self._lens_host[slot])
+                tokens = [int(t) for t in transcript[:valid]]
+                remaining = int(self._remaining[slot])
+            path = self._slot_path.pop(slot, [])
+            if path and self.prefix_cache is not None and tokens and remaining > 0:
+                self.prefix_cache.pin(path)
+                self.prefix_cache.release(path)  # the slot's own working refs
+            else:
+                if path and self.prefix_cache is not None:
+                    self.prefix_cache.release(path)
+                path = []
+            if not tokens or remaining <= 0:
+                continue  # nothing to resume from
+            records.append(
+                SalvagedSlot(slot=slot, tokens=tokens, path=path, remaining=remaining)
             )
+        self._salvage = records
+
+    def take_salvage(self) -> List[SalvagedSlot]:
+        """Collect (and clear) the salvage captured by the last failure. The
+        caller owns the records' eviction pins from here on — drop each via
+        :meth:`release_preempted` once its resume re-admitted or its request
+        was abandoned."""
+        salvage, self._salvage = self._salvage, []
+        return salvage
+
+    def discard_salvage(self) -> None:
+        """Unpin and drop uncollected salvage (reset/abort/unsupervised paths)."""
+        for rec in self._salvage:
+            if rec.path and self.prefix_cache is not None:
+                self.prefix_cache.unpin(rec.path)
+        self._salvage = []
+
+    def rebuild(self, *, resume: bool = True) -> None:  # graftlint: off-path (error recovery, not steady-state decode)
+        """Reallocate the engine's device state from host-retained params.
+
+        Unlike :meth:`reset`, the prefix-cache pool and radix index SURVIVE
+        (block saves donate only the pool, and their failures rebuild it
+        locally — see ``_extend_index``), so salvaged requests re-admit
+        through the ordinary prefix-hit path and pay only a suffix prefill.
+
+        ``resume=True`` (supervised recovery) reconstructs the PRNG key by
+        replaying the recorded number of key-consuming steps from the seeded
+        base, so resumed SAMPLED streams continue token-identically to a
+        fault-free run. ``resume=False`` (standalone auto-recovery; in-flight
+        work abandoned) reseeds like :meth:`reset` and drops uncollected
+        salvage.
+
+        Raises when the rebuild itself fails (a real allocation error, or an
+        injected ``FaultPlan.rebuild_failures``): the engine stays failed and
+        the supervisor retries with bounded exponential backoff.
+        """
+        if self._faults is not None:
+            self._faults.check_rebuild()
+        if not resume:
+            self._resets += 1
+            self._key_steps = 0
+            self.discard_salvage()
+        self._pending_events.clear()
+        self._active[:] = False
+        self._reserved[:] = False
+        self._partials.clear()
+        self._lens_host[:] = 0
+        self._remaining[:] = 0
+        self._slot_queue_wait.clear()
+        self._slot_temp[:] = self.temperature
+        self._slot_top_k[:] = 0
+        self._slot_top_p[:] = 1.0
+        for slot in list(self._slot_path):
+            self._release_prefix(slot)  # salvage holds its own pins by now
+        self._slot_tokens.clear()
+        self._init_device_state()
+        self._sync_sampling_mirrors()
+        if resume and self._key_steps:
+            # replay the consumed key advances (one split per any-active step)
+            # so the stream continues exactly where the failed burst cut it
+            key = self._key
+            for _ in range(self._key_steps):
+                key = jax.random.split(key)[0]
             if self._mesh is not None:
-                self._pool = jax.device_put(self._pool, self._cache_sharding)
+                key = jax.device_put(key, self._replicated)
+            self._key = key
+        self._device_poisoned = False
+        self._failed = False
+        self.rebuilds += 1
 
     def _apply_token(self, slot: int, token: int) -> StepEvent:
         """Advance the host mirrors for one decoded token (same rules as the
@@ -1081,10 +1454,9 @@ class DecodeEngine:
         queue_wait_ms = self._slot_queue_wait.pop(slot, None)
         if finished:
             self._active[slot] = False
-            if self.prefix_cache is not None:
-                if self.prefix_cache_generated:
-                    self._capture_generated(slot)
-                self._release_prefix(slot)
+            if self.prefix_cache is not None and self.prefix_cache_generated:
+                self._capture_generated(slot)
+            self._release_prefix(slot)
         return StepEvent(
             slot=slot, token=token, emit=not is_eos, finished=finished,
             queue_wait_ms=queue_wait_ms,
@@ -1131,6 +1503,19 @@ class DecodeEngine:
             else round(self.ema_queue_wait_ms, 3),
         }
 
+    def robustness_stats(self) -> Dict[str, Any]:
+        """Engine-side robustness counters for ``GET /stats`` (the supervisor
+        merges its own health/recovery counters alongside these)."""
+        stats: Dict[str, Any] = {
+            "engine_failures": self.failure_count,
+            "engine_rebuilds": self.rebuilds,
+            "quarantined_requests": self.quarantined_requests,
+            "salvage_pending": len(self._salvage),
+        }
+        if self._faults is not None:
+            stats["faults"] = self._faults.stats()
+        return stats
+
     def note_queue_wait(self, slot: int, wait_ms: Optional[float]) -> None:
         """Record how long ``slot``'s request sat queued before admission (the
         batcher calls this right after ``admit_many``). The value rides on the
@@ -1151,24 +1536,37 @@ class DecodeEngine:
         dispatched with."""
         if self._inflight is None:
             return []
-        burst, self._inflight = self._inflight, None
-        return self._replay_burst(burst)
+        burst, skip = self._inflight, self._inflight_skip
+        self._inflight, self._inflight_skip = None, set()
+        return self._replay_burst(burst, skip)
 
-    def _replay_burst(self, burst: Tuple[Any, Any, int]) -> List[StepEvent]:
-        """Block on one dispatched burst's ``(tokens, masks)`` and apply them.
+    def _replay_burst(
+        self, burst: Tuple[Any, Any, Any, int], skip: frozenset = frozenset()
+    ) -> List[StepEvent]:
+        """Block on one dispatched burst's ``(tokens, masks, bads)`` and apply them.
 
-        ONE fused ``device_get`` for tokens and masks; a device failure
-        surfacing here poisons the donated buffers, so it resets the engine
-        exactly like a dispatch failure."""
-        tokens, masks, _ = burst
+        ONE fused ``device_get`` for tokens, masks, and the per-step NaN
+        flags; a device failure surfacing here poisons the donated buffers,
+        so it fails the engine exactly like a dispatch failure. A flagged
+        ``(step, slot)`` quarantines THAT slot (its sampled token is garbage
+        and never delivered) while every other slot's tokens apply normally."""
+        tokens, masks, bads, _ = burst
         t0 = time.perf_counter()
         try:
-            # graftlint: disable=host-sync -- the ONE designed sync per tick: tokens+masks fused into a single device_get (PR-3 pipelined-decode contract)
-            tokens_host, masks_host = map(np.asarray, jax.device_get((tokens, masks)))
+            if self._faults is not None:
+                stall_ms = self._faults.take_fetch_stall_ms()
+                if stall_ms is not None:
+                    time.sleep(stall_ms / 1e3)  # a wedged device queue, to the watchdog's eye
+                self._faults.check_fetch()
+            # graftlint: disable=host-sync -- the ONE designed sync per tick: tokens+masks+nan-flags fused into a single device_get (PR-3 pipelined-decode contract)
+            tokens_host, masks_host, bads_host = map(
+                np.asarray, jax.device_get((tokens, masks, bads))
+            )
         except Exception:
-            self.reset()
+            self._on_failure()
             raise
         done = time.perf_counter()
+        self.last_heartbeat = time.monotonic()
         block_ms = (done - t0) * 1e3
         self.ema_fetch_block_ms = (
             block_ms
@@ -1178,11 +1576,49 @@ class DecodeEngine:
         self._last_fetch_done = done
         events: List[StepEvent] = []
         for i in range(tokens_host.shape[0]):
-            events.extend(
-                self._apply_token(int(slot), int(tokens_host[i, int(slot)]))
-                for slot in np.flatnonzero(masks_host[i])
-            )
+            if masks_host[i].any():
+                # mirrors the in-program key gate (any(active) at step start):
+                # lets a resume-rebuild replay the PRNG stream to this point
+                self._key_steps += 1
+            for slot in np.flatnonzero(masks_host[i]):
+                slot = int(slot)
+                if slot in skip:
+                    # the slot was quarantined while this burst was in flight:
+                    # its tokens here are garbage, and the slot may already
+                    # belong to a new occupant — drop them unconditionally
+                    continue
+                if not self._active[slot]:
+                    continue  # quarantined earlier in this burst: later steps are void
+                if bads_host[i, slot]:
+                    events.append(self._quarantine(slot))
+                    continue
+                events.append(self._apply_token(slot, int(tokens_host[i, slot])))
         return events
+
+    def _quarantine(self, slot: int) -> StepEvent:
+        """Terminate ONE slot whose logits went NaN/Inf: release it (without
+        indexing its possibly-poisoned generated KV), point-update its device
+        mirror inactive, and emit the structured failure event — siblings keep
+        decoding, which is the whole point vs the old batch-wide failure."""
+        self.quarantined_requests += 1
+        self._active[slot] = False
+        self._reserved[slot] = False
+        self._remaining[slot] = 0
+        self._slot_temp[slot] = self.temperature
+        self._slot_top_k[slot] = 0
+        self._slot_top_p[slot] = 1.0
+        self._slot_queue_wait.pop(slot, None)
+        self._release_prefix(slot)  # no generated-KV capture: it may be poisoned
+        self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
+        if self._inflight is not None:
+            # the already-dispatched next burst still decodes this slot under
+            # an active mask: its replay must not credit those garbage tokens
+            # to whoever occupies the slot by then
+            self._inflight_skip.add(slot)
+        if self._faults is not None:
+            self._faults.note_observed("nan_logits")
+        logger.warning("slot %d quarantined: non-finite logits", slot)
+        return StepEvent(slot=slot, token=-1, emit=False, finished=True, error="nan_logits")
 
     def step(self, lookahead: int = 1) -> List[StepEvent]:  # graftlint: hot-path
         """Decode for every active slot; returns per-slot events.
@@ -1204,9 +1640,12 @@ class DecodeEngine:
         unpipelined engines emit identical streams (greedy and fixed-seed
         sampled) under identical call schedules.
 
-        A device failure mid-step resets the engine (see :meth:`reset`) and
-        re-raises; every in-flight request is lost but the engine stays usable.
+        A device failure mid-step FAILS the engine (see :meth:`_on_failure`):
+        salvage is captured for a supervising batcher, the device state is
+        rebuilt in place from host-retained params, and the exception
+        re-raises — the engine stays usable either way.
         """
+        self._ensure_usable()
         events: List[StepEvent] = []
         if self._pending_events:
             # replayed by an out-of-band flush (cancel / contended admission):
@@ -1215,18 +1654,20 @@ class DecodeEngine:
             self._pending_events.clear()
         if self._partials:
             # chunked prefills advance one chunk per tick, between decode
-            # dispatches, so long prompts never stall the in-flight batch
+            # dispatches, so long prompts never stall the in-flight batch;
+            # per-slot chunk failures are absorbed inside (only a poisoning
+            # slot-insert failure reaches this handler)
             try:
                 self._advance_partials()
             except Exception:
-                self.reset()
+                self._on_failure()
                 raise
         if not self._active.any():
             return events
         lookahead = max(1, int(lookahead))
         # host-side accounting of the dispatched-but-unfetched burst: the host
         # mirrors lag it, so depth planning subtracts its steps
-        inflight_steps = self._inflight[2] if self._inflight is not None else 0
+        inflight_steps = self._inflight[3] if self._inflight is not None else 0
         room = np.minimum(
             self._remaining[self._active],
             (self.max_len - 1) - self._lens_host[self._active],
@@ -1260,6 +1701,10 @@ class DecodeEngine:
         t0 = time.perf_counter()
         device_was_idle = self._inflight is None
         try:
+            if self._faults is not None:
+                # injected dispatch failures take the SAME except path a real
+                # device error takes (nothing below special-cases injection)
+                self._faults.check_step_dispatch()
             (
                 self._cache,
                 self._last_logits,
@@ -1269,14 +1714,21 @@ class DecodeEngine:
                 self._key,
                 tokens,
                 masks,
+                bads,
             ) = fn(
                 self._variables, self._cache, self._last_logits, self._lens,
                 self._active_dev, self._remaining_dev, self._key,
                 self._temp_dev, self._top_k_dev, self._top_p_dev,
             )
         except Exception:
-            self.reset()
+            self._on_failure()
             raise
+        self.last_heartbeat = time.monotonic()
+        if self._faults is not None:
+            for bad_slot in self._faults.take_nan_slots():
+                # poison the slot's NEXT sampling input: the following step's
+                # in-program finiteness flag trips and the host quarantines it
+                self._last_logits = self._last_logits.at[bad_slot].set(jnp.nan)
         self.step_dispatches += 1
         if device_was_idle and self._last_fetch_done is not None:
             self.idle_dispatches += 1
@@ -1292,11 +1744,12 @@ class DecodeEngine:
                 if self.ema_host_gap_ms is None
                 else 0.8 * self.ema_host_gap_ms + 0.2 * gap_ms
             )
-        previous, self._inflight = self._inflight, (tokens, masks, lookahead)
+        previous, prev_skip = self._inflight, self._inflight_skip
+        self._inflight, self._inflight_skip = (tokens, masks, bads, lookahead), set()
         if previous is not None:
             # dispatch-ahead: the new step is already queued on the device
             # while the host blocks on (and then applies) the previous one
-            events.extend(self._replay_burst(previous))
+            events.extend(self._replay_burst(previous, prev_skip))
         if not self.pipeline:
             events.extend(self._fetch_inflight())  # hard sync (see utils.hard_sync)
         return events
@@ -1311,7 +1764,9 @@ class DecodeEngine:
         precisely because the pipeline is empty.
         """
         self._inflight = None
+        self._inflight_skip = set()
         self._pending_events.clear()
+        self.discard_salvage()
         self._active[:] = False
         self._reserved[:] = False
         self._partials.clear()
@@ -1333,6 +1788,7 @@ class DecodeEngine:
         :meth:`step`; the cancelled slot's device mirror is then point-updated
         to inactive so the device stops decoding it.
         """
+        self._ensure_usable()
         self._pending_events.extend(self._fetch_inflight())
         # the flush may have buffered this slot's own tokens: its consumer is
         # gone, and delivering them later could credit them to the slot's NEXT
@@ -1374,6 +1830,7 @@ class DecodeEngine:
         """
         if self.prefix_cache is None:
             raise RuntimeError("preempt requires the prefix cache (prefix_cache_blocks > 0)")
+        self._ensure_usable()
         # flush the in-flight step under the OLD slot mapping (same rule as
         # cancel): its tokens are real — they extend this slot's transcript
         # and reach its consumer through the buffered events
@@ -1474,6 +1931,20 @@ class _FutureSink:
         )
 
 
+def _as_engine_failure(
+    exc: BaseException, *, reason: str = "engine_failure", retryable: bool = True
+) -> EngineFailure:
+    """Wrap an arbitrary engine-side exception as the structured failure a
+    sink receives — never a bare ``str(exc)`` sink (injected faults keep
+    their site slug so chaos tests can assert attribution)."""
+    if isinstance(exc, EngineFailure):
+        return exc
+    site = getattr(exc, "site", None)
+    if site is not None:
+        reason = f"injected_{site}"
+    return EngineFailure(f"{type(exc).__name__}: {exc}", reason=reason, retryable=retryable)
+
+
 _STREAM_DONE = object()
 
 
@@ -1520,15 +1991,37 @@ class ContinuousBatcher:
         arrivals against a full house. ``None`` builds the default policy
         (requests without ``priority``/``deadline_ms`` behave like the old
         FIFO queue, now bounded).
+    :param supervisor: an
+        :class:`~unionml_tpu.serving.supervisor.EngineSupervisor` enabling
+        SUPERVISED RECOVERY: on an engine-wide failure every salvageable
+        request is checkpoint-resumed through the scheduler (token-identical,
+        its sink keeping the tokens already delivered) after an in-place
+        engine rebuild — with bounded-exponential-backoff retries and a
+        health state machine ``/healthz`` can serve. ``None`` preserves the
+        unsupervised contract: in-flight work fails (with structured,
+        machine-readable reasons) and the engine auto-recovers for the next
+        request.
     """
 
     def __init__(
-        self, engine: DecodeEngine, *, lookahead: int = 1, scheduler: Optional[Any] = None
+        self,
+        engine: DecodeEngine,
+        *,
+        lookahead: int = 1,
+        scheduler: Optional[Any] = None,
+        supervisor: Optional[Any] = None,
     ) -> None:
         from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
 
         self._engine = engine
         self._lookahead = max(1, int(lookahead))
+        #: the recovery policy layer (:class:`~unionml_tpu.serving.supervisor.
+        #: EngineSupervisor`): with one attached, an engine failure salvages
+        #: and RESUMES every recoverable request instead of failing the house;
+        #: None preserves the fail-everything-structured behavior
+        self.supervisor = supervisor
+        if supervisor is not None:
+            supervisor.attach(engine)
         #: the SLO admission-control queue (thread-safe: owns its own lock)
         self.scheduler = (
             scheduler
@@ -1573,13 +2066,17 @@ class ContinuousBatcher:
         if prompt.size == 0:
             raise ValueError("empty prompt")
         self._engine.bucket_for(prompt.size)
+        if self.supervisor is not None and self.supervisor.state == "failed":
+            # the rebuild budget is exhausted: fail fast with the structured
+            # terminal error instead of queueing work that can never run
+            raise self.supervisor.unavailable_error()
         ticket = self.scheduler.make_ticket(
             prompt, int(max_new_tokens), sampling, sink,
             priority=priority, deadline_ms=deadline_ms,
         )
         with self._lock:
             if self._closed:
-                raise RuntimeError("batcher is closed")
+                raise EngineFailure("batcher is closed", reason="batcher_closed")
             # shed decisions raise HERE (caller side) while the close check
             # still holds, so a shed request never reaches a closed queue
             displaced = self.scheduler.submit(ticket)
@@ -1778,52 +2275,191 @@ class ContinuousBatcher:
                 admissible.append(ticket)
             if not admissible:
                 continue
-            resets_before = getattr(self._engine, "_resets", 0)
-            try:
-                # one admission call: same-bucket prompts share batched prefill
-                # dispatches (⌈N/prefill_batch⌉ per bucket, not N)
-                slots = self._engine.admit_many(
-                    [(t.prompt, t.budget, t.sampling) for t in admissible]
+            if not self._admit_batch(admissible):
+                return  # engine failure ended this admission round
+
+    def _drain_flush_events(self) -> None:
+        """Deliver events an admission-time pipeline flush buffered — under
+        the OLD sink mapping, BEFORE any new sink takes over a slot."""
+        if getattr(self._engine, "has_pending_events", False):
+            self._dispatch_events(self._engine.take_pending_events())
+
+    def _register(self, slot: int, ticket: Any) -> None:
+        """Bind an admitted ticket to its slot (and retire its resume pin:
+        the re-admission holds its own references on the blocks now)."""
+        self._sinks[slot] = ticket.sink
+        self._slot_meta[slot] = ticket
+        self._engine.note_queue_wait(slot, ticket.queue_wait_ms)
+        if ticket.resume is not None:
+            self._engine.release_preempted(ticket.resume)
+            ticket.resume = None
+
+    def _admit_batch(self, admissible: List[Any]) -> bool:  # graftlint: off-path (admission, not steady-state decode)
+        """Admit popped tickets with per-request failure attribution.
+
+        One admission call batches same-bucket prefills; when it fails
+        WITHOUT an engine failure (the engine rolled this call back cleanly),
+        the batch re-admits one request at a time so only the raiser fails —
+        with a structured reason — and every sibling proceeds. An engine
+        failure hands the un-admitted tickets to the recovery path (they
+        requeue untouched) and returns False to end the admission round.
+        """
+        failures_before = getattr(self._engine, "failure_count", 0)
+        try:
+            slots = self._engine.admit_many(
+                [(t.prompt, t.budget, t.sampling) for t in admissible]
+            )
+        except Exception as exc:
+            if getattr(self._engine, "failure_count", 0) != failures_before:
+                self._handle_engine_failure(exc, pending=admissible)
+                return False
+            self._drain_flush_events()
+            if len(admissible) == 1:
+                ticket = admissible[0]
+                self._release_ticket(ticket)
+                self._deliver(
+                    ticket.sink, "fail", _as_engine_failure(exc, reason="prefill_failed")
                 )
-            except Exception as exc:  # device-side failure: fail this batch, keep serving
-                for ticket in admissible:
+                return True
+            for ticket in admissible:
+                failures_before = getattr(self._engine, "failure_count", 0)
+                try:
+                    (slot,) = self._engine.admit_many(
+                        [(ticket.prompt, ticket.budget, ticket.sampling)]
+                    )
+                except Exception as one_exc:
+                    if getattr(self._engine, "failure_count", 0) != failures_before:
+                        self._handle_engine_failure(one_exc, pending=[ticket])
+                        return False
+                    self._drain_flush_events()
                     self._release_ticket(ticket)
-                    self._deliver(ticket.sink, "fail", exc)
-                if getattr(self._engine, "_resets", 0) != resets_before:
-                    # the failure reset the engine (a pipeline flush inside
-                    # admission can surface a deferred device error): every
-                    # in-flight request died with the device state — fail their
-                    # sinks too instead of letting their futures hang forever
-                    for sink in self._sinks.values():
-                        self._deliver(sink, "fail", RuntimeError(str(exc)))
-                    self._sinks.clear()
-                    self._slot_meta.clear()
-                continue
-            if getattr(self._engine, "has_pending_events", False):
-                # admission flushed the pipeline and may have retired previous
-                # occupants of the slots just handed out: deliver their events
-                # to the OLD sinks before the new sinks take over the mapping
-                self._dispatch_events(self._engine.take_pending_events())
-            for slot, ticket in zip(slots, admissible):
-                self._sinks[slot] = ticket.sink
-                self._slot_meta[slot] = ticket
-                self._engine.note_queue_wait(slot, ticket.queue_wait_ms)
-                if ticket.resume is not None:
-                    # the resume re-admission holds its own references on the
-                    # checkpointed blocks now: the preemption pin can go
-                    self._engine.release_preempted(ticket.resume)
-                    ticket.resume = None
+                    self._deliver(
+                        ticket.sink, "fail",
+                        _as_engine_failure(one_exc, reason="prefill_failed"),
+                    )
+                    continue
+                self._drain_flush_events()
+                self._register(slot, ticket)
+            return True
+        self._drain_flush_events()
+        for slot, ticket in zip(slots, admissible):
+            self._register(slot, ticket)
+        return True
 
     def _fail_all(self, exc: Exception) -> None:  # graftlint: off-path (error path)
-        """Fail every in-flight request and abandon the engine's slots."""
+        """Fail every in-flight request (structured) and abandon the engine's
+        slots — the unsupervised fallback when no recovery policy is attached."""
+        failure = _as_engine_failure(exc)
         for sink in self._sinks.values():
-            self._deliver(sink, "fail", RuntimeError(str(exc)))
+            self._deliver(sink, "fail", failure)
         self._sinks.clear()
         self._slot_meta.clear()
         self._engine.abort_all()
 
+    def _handle_engine_failure(self, exc: BaseException, pending: Sequence[Any] = ()) -> None:  # graftlint: off-path (error recovery)
+        """Recover from an engine-wide failure.
+
+        With a supervisor: every salvageable request becomes a RESUME ticket
+        (its sink keeps the tokens already delivered; the transcript becomes
+        the prompt, the unspent budget carries over, and the pinned salvage
+        path shrinks the re-prefill to a suffix) re-queued through the
+        scheduler — deadlines and priorities intact — after the engine is
+        confirmed rebuilt (bounded-backoff retries when the in-place rebuild
+        failed). Unsalvageable requests fail with a structured, machine-
+        readable reason; rebuild exhaustion fails EVERYTHING (pending,
+        resumes, the whole queue) and leaves the supervisor ``failed``.
+
+        Without a supervisor: the old contract — all in-flight work fails,
+        now with structured reasons — plus salvage-pin hygiene.
+
+        ``pending`` carries popped-but-unadmitted tickets from a failed
+        admission call; they re-queue untouched (no tokens were delivered).
+        """
+        engine = self._engine
+        if hasattr(engine, "note_external_failure"):
+            engine.note_external_failure()  # escalate poisoned out-of-band calls
+        sup = self.supervisor
+        if sup is None:
+            if hasattr(engine, "discard_salvage"):
+                engine.discard_salvage()
+            failure = _as_engine_failure(exc)
+            for ticket in pending:
+                self._release_ticket(ticket)
+                self._deliver(ticket.sink, "fail", failure)
+            self._fail_all(exc)
+            return
+        sup.note_failure(exc)
+        resumes: List[Any] = []
+        for rec in (engine.take_salvage() if hasattr(engine, "take_salvage") else []):
+            sink = self._sinks.pop(rec.slot, None)
+            meta = self._slot_meta.pop(rec.slot, None)
+            pin = PreemptedSlot(tokens=list(rec.tokens), path=rec.path)
+            if sink is None or meta is None or sink.cancelled:
+                engine.release_preempted(pin)  # no consumer: drop the checkpoint
+                continue
+            try:
+                engine.validate_request(rec.tokens, max(1, int(rec.remaining)), **meta.sampling)
+            except Exception as not_resumable:
+                engine.release_preempted(pin)
+                sup.note_request_failed()
+                self._deliver(
+                    sink, "fail",
+                    EngineFailure(
+                        f"request not resumable after engine failure: {not_resumable}",
+                        reason="request_unrecoverable", retryable=False,
+                    ),
+                )
+                if meta.resume is not None:
+                    engine.release_preempted(meta.resume)
+                    meta.resume = None
+                continue
+            if meta.resume is not None:
+                # preempt-then-failure: the fresher salvage checkpoint
+                # supersedes the preemption's — its pin can go now
+                engine.release_preempted(meta.resume)
+            meta.prompt = np.asarray(rec.tokens, dtype=np.int32)
+            meta.budget = int(rec.remaining)
+            meta.resume = pin
+            meta.sink = sink
+            resumes.append(meta)
+        # any sink still mapped had nothing salvageable behind it: fail it
+        failure = _as_engine_failure(exc)
+        for slot, sink in list(self._sinks.items()):
+            meta = self._slot_meta.pop(slot, None)
+            if meta is not None:
+                self._release_ticket(meta)
+            sup.note_request_failed()
+            self._deliver(sink, "fail", failure)
+        self._sinks.clear()
+        self._slot_meta.clear()
+        if getattr(engine, "failed", False):
+            rebuilt = sup.run_rebuild(engine.rebuild)
+        else:
+            sup.note_rebuilt()  # the engine already rebuilt itself in place
+            rebuilt = True
+        if not rebuilt:
+            unavailable = sup.unavailable_error()
+            for meta in resumes:
+                if meta.resume is not None:
+                    engine.release_preempted(meta.resume)
+                    meta.resume = None
+                sup.note_request_failed()
+                self._deliver(meta.sink, "fail", unavailable)
+            for ticket in list(pending) + self.scheduler.drain():
+                self._release_ticket(ticket)
+                sup.note_request_failed()
+                self._deliver(ticket.sink, "fail", unavailable)
+            return
+        for meta in resumes:
+            self.scheduler.requeue(meta, preemption=False)
+        if resumes:
+            sup.note_recovered(len(resumes))
+        for ticket in pending:
+            self.scheduler.requeue(ticket, preemption=False)
+
     def _dispatch_events(self, events) -> None:
-        """Fan one step's events out to their sinks (cancel on dead consumers)."""
+        """Fan one step's events out to their sinks (cancel on dead consumers;
+        engine-terminated requests fail with their structured reason)."""
         for event in events:
             sink = self._sinks.get(event.slot)
             if sink is None:
@@ -1837,6 +2473,24 @@ class ContinuousBatcher:
                 # wrong occupant. Only a still-running slot needs the cancel.
                 if not event.finished:
                     self._engine.cancel(event.slot)
+                continue
+            if event.error is not None:
+                # the engine terminated this request (NaN quarantine, chunked-
+                # prefill death): the slot is already free engine-side, so only
+                # the consumer-side failure remains to deliver
+                del self._sinks[event.slot]
+                meta = self._slot_meta.pop(event.slot, None)
+                if meta is not None:
+                    self._release_ticket(meta)
+                if self.supervisor is not None:
+                    self.supervisor.note_request_failed()
+                self._deliver(
+                    sink, "fail",
+                    EngineFailure(
+                        f"request terminated by the engine: {event.error}",
+                        reason=event.error,
+                    ),
+                )
                 continue
             ok = True
             if event.emit:
@@ -1859,7 +2513,14 @@ class ContinuousBatcher:
             if done:
                 self._drain_orphans()
                 return
-            self._admit()
+            try:
+                self._admit()
+            except Exception as exc:
+                # _admit handles admission failures itself; what lands here is
+                # scheduler-policy engine work (deadline cancel, preempt) dying
+                logger.exception("admission round failed")
+                self._handle_engine_failure(exc)
+                continue
             if self._engine.num_active == 0 and (
                 self._engine.has_pending_prefill
                 or getattr(self._engine, "has_pending_events", False)
@@ -1871,7 +2532,7 @@ class ContinuousBatcher:
                     events = self._engine.step()
                 except Exception as exc:
                     logger.exception("chunked-prefill tick failed")
-                    self._fail_all(exc)
+                    self._handle_engine_failure(exc)
                     continue
                 self._dispatch_events(events)
                 continue
@@ -1893,26 +2554,48 @@ class ContinuousBatcher:
                 events = self._engine.step(
                     min(self._lookahead, 4) if contended else self._lookahead
                 )
-            except Exception as exc:  # fail every in-flight request loudly
+            except Exception as exc:  # recover (supervised) or fail loudly
                 logger.exception("continuous-batching step failed")
-                self._fail_all(exc)
+                self._handle_engine_failure(exc)
                 continue
             self._dispatch_events(events)
 
-    def close(self) -> None:
-        """Shut the batcher down: every still-QUEUED request fails promptly
-        with ``RuntimeError("batcher closed")`` (futures/streams must never
-        hang on a closed batcher), running requests drain, and the worker
-        exits. Preempted checkpoints of failed tickets are unpinned on the
-        worker thread (the only prefix-cache mutator) when it is alive."""
+    def drain(self, timeout_s: float = 5.0) -> None:
+        """Graceful shutdown, phase one: stop admitting NEW submissions (they
+        fail fast with the structured ``batcher_closed`` error) while queued
+        and running requests keep decoding to completion, for up to
+        ``timeout_s``. Whatever remains after the window is failed promptly by
+        the :meth:`close` this ends with — a bounded drain, never a hang."""
         with self._lock:
             self._closed = True
+        self._work.set()
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while time.monotonic() < deadline:
+            worker = self._worker
+            if worker is None or not worker.is_alive():
+                break  # nothing in flight can make progress anyway
+            # advisory cross-thread reads: the worker owns these, but a stale
+            # read only costs one extra 20ms poll
+            if not self.scheduler.depth and not self._sinks and self._engine.num_active == 0:
+                break
+            time.sleep(0.02)
+        self.close()
+
+    def close(self) -> None:
+        """Shut the batcher down: every still-QUEUED request fails promptly
+        with the structured ``batcher_closed`` error (futures/streams must
+        never hang on a closed batcher), running requests drain, and the
+        worker exits. Preempted checkpoints of failed tickets are unpinned on
+        the worker thread (the only prefix-cache mutator) when it is alive."""
+        with self._lock:
+            self._closed = True
+        closed_exc = EngineFailure("batcher closed", reason="batcher_closed")
         orphans: List[Any] = []
         for ticket in self.scheduler.drain():
             if ticket.resume is not None:
                 orphans.append(ticket.resume)
                 ticket.resume = None
-            self._deliver(ticket.sink, "fail", RuntimeError("batcher closed"))
+            self._deliver(ticket.sink, "fail", closed_exc)
         worker = self._worker
         if orphans:
             if worker is not None and worker.is_alive():
@@ -1929,3 +2612,5 @@ class ContinuousBatcher:
                 # engine failure before close): nothing else touches the cache
                 # now, so the orphaned pins can drop here
                 self._drain_orphans()
+        if self.supervisor is not None:
+            self.supervisor.close()

@@ -293,15 +293,18 @@ class SLOScheduler:
             self._queued.append(ticket)
             return displaced
 
-    def requeue(self, ticket: Ticket) -> None:
-        """Put a preempted ticket back in the queue (bypasses the bound and
-        the infeasibility shed: its work is already partially paid for)."""
+    def requeue(self, ticket: Ticket, *, preemption: bool = True) -> None:
+        """Put a preempted — or failure-salvaged, with ``preemption=False`` —
+        ticket back in the queue (bypasses the bound and the infeasibility
+        shed: its work is already partially paid for). Deadlines and class
+        ride along unchanged, so SLO enforcement survives recovery."""
         with self._lock:
             ticket.seq = self._seq
             self._seq += 1
             ticket.queue_wait_ms = None
             self._queued.append(ticket)
-            self.preemptions += 1
+            if preemption:
+                self.preemptions += 1
 
     # ---------------------------------------------------------------- dispatch
 

@@ -52,6 +52,7 @@ class SpeculativeBatcher:
         gamma: int = 4,
         max_len: Optional[int] = None,
         scheduler: Optional[Any] = None,
+        faults: Optional[Any] = None,
     ) -> None:
         from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
 
@@ -60,6 +61,11 @@ class SpeculativeBatcher:
         self._draft = draft
         self._draft_variables = draft_variables
         self._gamma = int(gamma)
+        #: deterministic fault injection (:class:`~unionml_tpu.serving.faults.
+        #: FaultPlan`); None = production (one host branch per request)
+        self._faults = faults
+        #: requests that died in a speculative round (structured failures)
+        self.round_failures = 0  # guarded-by: _lock
         self._max_len = int(max_len or target.config.max_position_embeddings)
         self._lock = threading.Lock()  # serializes device work across requests
         #: SLO admission control shared-shape with ContinuousBatcher (/stats)
@@ -157,6 +163,7 @@ class SpeculativeBatcher:
 
     def _run_current(self, prompt: np.ndarray, max_new_tokens: int, temperature: float, seed) -> List[int]:
         from unionml_tpu.models.speculative import speculative_generate
+        from unionml_tpu.serving.faults import EngineFailure
 
         with self._lock:
             if seed is not None:
@@ -166,6 +173,8 @@ class SpeculativeBatcher:
             self.engine.num_active = 1
             self.engine.requests_admitted += 1
             try:
+                if self._faults is not None:
+                    self._faults.check_speculative_round()
                 # graftlint: disable=lock-order -- _lock EXISTS to serialize device work across requests (single-stream design, see class docstring); blocking under it is the design, and _await_turn admits exactly one holder
                 out = speculative_generate(
                     self._target,
@@ -178,6 +187,15 @@ class SpeculativeBatcher:
                     temperature=temperature,
                     rng=rng,
                 )
+            except Exception as exc:
+                # every round's device state is call-local (no persistent KV or
+                # donated engine buffers), so a failure costs exactly this
+                # request — structured, and the next request runs clean
+                self.round_failures += 1
+                logger.warning("speculative round failed: %s", exc)
+                raise EngineFailure(
+                    f"speculative round failed: {exc}", reason="speculative_round_failed"
+                ) from exc
             finally:
                 self.engine.num_active = 0
             tokens = [int(t) for t in np.asarray(out)[0, prompt.size :]]

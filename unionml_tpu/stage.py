@@ -204,7 +204,7 @@ def _default_cache_root() -> Path:
 def _fingerprint(payload: Any) -> str:
     try:
         raw = pickle.dumps(payload)
-    except Exception:
+    except Exception:  # graftlint: disable=swallowed-exception -- unpicklable payloads get an empty fingerprint, which disables caching for them by design
         return ""
     return hashlib.sha256(raw).hexdigest()
 
