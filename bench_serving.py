@@ -972,6 +972,124 @@ def bench_speculative(iters: int = 20, max_new_tokens: int = 32, gamma: int = 4)
     }
 
 
+def bench_fleet(replica_counts=(1, 2, 4), n_groups=4, n_per_group=8,
+                prefix_tokens=24, suffix_tokens=6, max_new_tokens=16, num_slots=2):
+    """Fleet scaling phase: a prefix-heavy request mix (``n_groups`` shared
+    prefixes × ``n_per_group`` unique suffixes, 1-in-4 interactive) served
+    through an :class:`~unionml_tpu.serving.fleet.EngineFleet` at each replica
+    count. Replicas split the device set into sub-meshes when it divides
+    (:func:`~unionml_tpu.serving.fleet.split_mesh`); otherwise every replica
+    shares the default device — routing behavior is still exercised, only the
+    throughput scaling flattens.
+
+    Per replica count, two router arms A/B the tentpole claim:
+
+    - ``affinity`` (prefix-digest scoring): group-mates land on the replica
+      whose radix cache holds their shared prefix;
+    - ``random`` (seeded uniform): the baseline that scatters them.
+
+    The router-level prefix-hit rate is read after a COLD pass (empty digest
+    indexes and engine caches — the honest A/B; a warm pass would let random
+    routing hit caches that every replica has already filled). Aggregate
+    decode tok/s and per-class p99 TTFT come from a second, warm pass so XLA
+    compiles stay out of the timings.
+    """
+    import asyncio
+    import contextlib
+
+    import jax
+
+    from unionml_tpu.serving.continuous import DecodeEngine
+    from unionml_tpu.serving.fleet import EngineFleet, FleetConfig, split_mesh
+    from unionml_tpu.serving.supervisor import EngineSupervisor
+
+    config, model, variables = _bench_gpt()
+    rng = np.random.default_rng(0)
+    groups = [rng.integers(1, config.vocab_size, size=prefix_tokens).tolist()
+              for _ in range(n_groups)]
+    requests = []
+    for j in range(n_per_group):  # interleave groups: the adversarial arrival order
+        for prefix in groups:
+            suffix = rng.integers(1, config.vocab_size, size=suffix_tokens).tolist()
+            requests.append((prefix + suffix, "interactive" if j % 4 == 0 else "batch"))
+
+    def build(n, policy):
+        devices = jax.devices()
+        meshes = [None] * n
+        if n > 1 and len(devices) % n == 0 and len(devices) // n >= 2:
+            parent = _serving_mesh(len(devices), config.num_heads)
+            try:
+                meshes = split_mesh(parent, n)
+            except ValueError:
+                meshes = [None] * n
+        engines = [
+            DecodeEngine(model, variables, num_slots=num_slots, max_len=128,
+                         prefill_buckets=(32, 48), mesh=m,
+                         prefix_cache_blocks=256, prefix_block_size=8)
+            for m in meshes
+        ]
+        # patient watchdogs: the cold pass holds XLA compiles longer than the
+        # default stall timeout, and a degraded-flapping replica would skew
+        # the routing A/B
+        sups = [EngineSupervisor(stall_timeout_s=120.0) for _ in engines]
+        return EngineFleet(
+            engines, config=FleetConfig(policy=policy, seed=0), supervisors=sups
+        )
+
+    def pct99(xs):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(int(len(xs) * 0.99), len(xs) - 1)], 2)
+
+    def drive(fleet):
+        ttft = {"interactive": [], "batch": []}
+
+        async def one(prompt, cls):
+            loop = asyncio.get_running_loop()
+            t0, first = loop.time(), True
+            agen = fleet.stream(prompt, max_new_tokens, priority=cls)
+            async with contextlib.aclosing(agen) as it:
+                async for _ in it:
+                    if first:
+                        ttft[cls].append((loop.time() - t0) * 1e3)
+                        first = False
+
+        async def run_all():
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one(p, cls) for p, cls in requests])
+            return time.perf_counter() - t0
+
+        return asyncio.run(run_all()), ttft
+
+    out = {"n_requests": len(requests), "n_groups": n_groups,
+           "prefix_tokens": prefix_tokens, "max_new_tokens": max_new_tokens,
+           "num_slots": num_slots, "per_replicas": {}}
+    for n in replica_counts:
+        entry = {}
+        for policy in ("affinity", "random"):
+            fleet = build(n, policy)
+            try:
+                drive(fleet)  # cold pass: compiles + the honest hit-rate A/B
+                cold = fleet.router.stats()
+                total_s, ttft = drive(fleet)  # warm pass: timings
+                arm = {
+                    "prefix_hit_rate_cold": cold["prefix_hit_rate"],
+                    "hit_blocks_cold": cold["hit_blocks"],
+                    "lookup_blocks_cold": cold["lookup_blocks"],
+                }
+                if policy == "affinity":
+                    arm["total_s"] = round(total_s, 4)
+                    arm["decode_tok_s"] = round(len(requests) * max_new_tokens / total_s, 1)
+                    arm["ttft_p99_interactive_ms"] = pct99(ttft["interactive"])
+                    arm["ttft_p99_batch_ms"] = pct99(ttft["batch"])
+                entry[policy] = arm
+            finally:
+                fleet.close()
+        out["per_replicas"][str(n)] = entry
+    return out
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--bert-base", action="store_true", help="bench full BERT-base (TPU)")
@@ -1003,6 +1121,13 @@ def main():
                         "recovered-token parity vs a clean run, structured-failure "
                         "counts, and pinned-block leaks. Runs ONLY this phase (like "
                         "--slo-mix); combine with --mesh N for the sharded engine")
+    parser.add_argument("--fleet", type=int, nargs="+", default=None, metavar="N",
+                        help="focused fleet-scaling phase: a prefix-heavy request mix "
+                        "through an EngineFleet at each replica count N (devices split "
+                        "into per-replica sub-meshes when they divide) — aggregate "
+                        "decode tok/s, per-class p99 TTFT, and the router-level "
+                        "prefix-affinity vs random-routing cold hit-rate A/B. Runs "
+                        "ONLY this phase (like --slo-mix)")
     parser.add_argument("--pipeline", choices=("on", "off", "ab"), default=None,
                         help="focused depth-1 pipelined-decode phase: decode tok/s + "
                         "host-gap ms at lookahead=1 with dispatch-ahead on/off "
@@ -1024,7 +1149,7 @@ def main():
     from bench_util import resolve_artifact_path
 
     backend = jax.default_backend()
-    if args.pipeline or args.mesh or args.slo_mix or args.chaos:
+    if args.pipeline or args.mesh or args.slo_mix or args.chaos or args.fleet:
         import os
 
         base, ext = os.path.splitext(args.out)
@@ -1034,6 +1159,8 @@ def main():
             base = f"{base}_slo"
         if args.chaos:
             base = f"{base}_chaos"
+        if args.fleet:
+            base = f"{base}_fleet"
         if args.mesh:
             base = f"{base}_mesh{args.mesh}"
         args.out = f"{base}{ext}"
@@ -1044,6 +1171,30 @@ def main():
         "cold_start_excluded": True,
         "models": {},
     }
+
+    if args.fleet:
+        fl = bench_fleet(replica_counts=tuple(args.fleet))
+        results["models"]["fleet"] = fl
+        line = {"metric": "fleet_decode_tok_s", "backend": backend,
+                "n_requests": fl["n_requests"]}
+        for n, entry in fl["per_replicas"].items():
+            line[f"tok_s_r{n}"] = entry["affinity"].get("decode_tok_s")
+            line[f"ttft_p99_interactive_r{n}"] = entry["affinity"].get("ttft_p99_interactive_ms")
+            line[f"hit_rate_affinity_r{n}"] = entry["affinity"]["prefix_hit_rate_cold"]
+            line[f"hit_rate_random_r{n}"] = entry["random"]["prefix_hit_rate_cold"]
+        print(json.dumps(line))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        # the router A/B GATES at >= 2 replicas: affinity losing to random
+        # routing means the digest index is broken, fail the battery step
+        for n, entry in fl["per_replicas"].items():
+            if int(n) >= 2:
+                aff = entry["affinity"]["prefix_hit_rate_cold"] or 0.0
+                rnd = entry["random"]["prefix_hit_rate_cold"] or 0.0
+                if aff <= rnd:
+                    return 1
+        return 0
 
     if args.chaos:
         if args.mesh and len(jax.devices()) < args.mesh:

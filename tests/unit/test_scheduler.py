@@ -528,6 +528,14 @@ def test_http_status_codes_and_reasons(gpt):
     model, variables = gpt
     app = _app(model, variables, num_slots=1, max_len=64, prefill_buckets=(4, 8))
 
+    def _set_wait_ema(sched, value):
+        # the infeasibility check prefers the ticket's class EMA over the
+        # global one, so pinning "observed queueing" means pinning both
+        with sched._lock:
+            sched.queue_wait_ema_ms = value
+            for name in sched.queue_wait_ema_ms_by_class:
+                sched.queue_wait_ema_ms_by_class[name] = value
+
     async def main():
         client = TestClient(TestServer(app))
         await client.start_server()
@@ -580,10 +588,9 @@ def test_http_status_codes_and_reasons(gpt):
             assert (await filler).status == 200
 
             # --- 504: queued behind a fresh hog with an expiring deadline
-            # (clear the observed-wait EMA first: with history it would shed
+            # (clear the observed-wait EMAs first: with history it would shed
             # 503-infeasible at submit instead of expiring in the queue)
-            with gen.scheduler._lock:
-                gen.scheduler.queue_wait_ema_ms = None
+            _set_wait_ema(gen.scheduler, None)
             # the hog must outlive the queued request's deadline even on a
             # warm engine: 60 decode steps vs a 25ms budget
             hog2 = asyncio.ensure_future(
@@ -602,8 +609,7 @@ def test_http_status_codes_and_reasons(gpt):
             assert (await hog2).status == 200
 
             # --- 503: observed queueing makes the deadline infeasible
-            with gen.scheduler._lock:
-                gen.scheduler.queue_wait_ema_ms = 60_000.0
+            _set_wait_ema(gen.scheduler, 60_000.0)
             resp = await client.post(
                 "/generate",
                 json={"prompt_ids": [1, 2], "max_new_tokens": 4, "deadline_ms": 50},
@@ -611,20 +617,17 @@ def test_http_status_codes_and_reasons(gpt):
             assert resp.status == 503, await resp.text()
             assert (await resp.json())["error"]["reason"] == "deadline_infeasible"
             assert "Retry-After" in resp.headers
-            with gen.scheduler._lock:
-                gen.scheduler.queue_wait_ema_ms = None
+            _set_wait_ema(gen.scheduler, None)
 
             # --- streaming shed surfaces as a real status (not in-band)
-            with gen.scheduler._lock:
-                gen.scheduler.queue_wait_ema_ms = 60_000.0
+            _set_wait_ema(gen.scheduler, 60_000.0)
             resp = await client.post(
                 "/generate",
                 json={"prompt_ids": [1, 2], "max_new_tokens": 4, "stream": True,
                       "deadline_ms": 50},
             )
             assert resp.status == 503, await resp.text()
-            with gen.scheduler._lock:
-                gen.scheduler.queue_wait_ema_ms = None
+            _set_wait_ema(gen.scheduler, None)
 
             # --- /stats carries the scheduler block
             stats = await (await client.get("/stats")).json()

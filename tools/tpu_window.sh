@@ -99,6 +99,11 @@ run serving_slo 300 python bench_serving.py --slo-mix
 # supervised batcher — recovery latency, recovered-token parity (the phase
 # exits nonzero on a parity miss or a pinned-block leak, failing the step)
 run serving_chaos 300 python bench_serving.py --chaos
+# fleet scaling: prefix-heavy mix through an EngineFleet at 1/2/4 replicas
+# (devices split into per-replica sub-meshes) — aggregate decode tok/s,
+# per-class p99 TTFT, and the prefix-affinity vs random routing hit-rate A/B
+# (the phase exits nonzero when affinity loses the A/B at >= 2 replicas)
+run serving_fleet 420 python bench_serving.py --fleet 1 2 4
 # most expensive phase last: ~1.3B-param decode, bf16 vs int8 weight-only
 run int8 600 python bench_int8.py
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_window.sh: battery done" >> TPU_PROBES.log

@@ -281,6 +281,14 @@ def fetch_predictions(app: str, app_version: Optional[str], prediction_id: str, 
 @click.option("--remote", is_flag=True, help="Load the model from backend lineage instead of a file.")
 @click.option("--app-version", "-v", default=None)
 @click.option("--model-version", "-m", default="latest", show_default=True)
+@click.option(
+    "--replicas",
+    default=1,
+    show_default=True,
+    help="Generation engine replicas behind the fleet router (requires the "
+    "app to define a generator factory; >1 enables /generate session "
+    "routing and failover).",
+)
 def serve(
     app: str,
     model_path: Optional[Path],
@@ -289,15 +297,24 @@ def serve(
     remote: bool,
     app_version: Optional[str],
     model_version: str,
+    replicas: int,
 ) -> None:
     """Serve the model over HTTP with a resident compiled predictor."""
     if model_path is not None:
         os.environ["UNIONML_MODEL_PATH"] = str(model_path)
+    if replicas < 1:
+        raise click.BadParameter("--replicas must be >= 1")
     model = _load_model(app)
     from unionml_tpu.serving import run_app, serving_app
 
-    http_app = serving_app(model, remote=remote, app_version=app_version, model_version=model_version)
-    logger.info("Serving %s on %s:%d", app, host, port)
+    serving_kwargs = {}
+    if replicas > 1:
+        serving_kwargs["generate_replicas"] = replicas
+    http_app = serving_app(
+        model, remote=remote, app_version=app_version, model_version=model_version,
+        **serving_kwargs,
+    )
+    logger.info("Serving %s on %s:%d (replicas=%d)", app, host, port, replicas)
     run_app(http_app, host=host, port=port)
 
 

@@ -5,6 +5,7 @@ from typing import Any, Optional
 from unionml_tpu.serving.app import build_aiohttp_app, jsonable, load_model_artifact, run_app
 from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
 from unionml_tpu.serving.faults import EngineFailure, FaultError, FaultPlan
+from unionml_tpu.serving.fleet import EngineFleet, FleetConfig, Router, split_mesh
 from unionml_tpu.serving.prefix_cache import PrefixCache
 from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
 from unionml_tpu.serving.speculative import SpeculativeBatcher
@@ -63,13 +64,17 @@ __all__ = [
     "ContinuousBatcher",
     "DecodeEngine",
     "EngineFailure",
+    "EngineFleet",
     "EngineSupervisor",
     "FaultError",
     "FaultPlan",
+    "FleetConfig",
     "PrefixCache",
     "ResidentPredictor",
+    "Router",
     "SLOScheduler",
     "SchedulerConfig",
+    "split_mesh",
     "build_aiohttp_app",
     "jsonable",
     "load_model_artifact",

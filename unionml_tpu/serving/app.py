@@ -94,6 +94,9 @@ def build_aiohttp_app(
     generate_scheduler: Optional[Any] = None,
     generate_supervisor: Optional[Any] = None,
     generate_drain_s: float = 5.0,
+    generate_replicas: int = 1,
+    generate_fleet_config: Optional[Any] = None,
+    retry_jitter_rng: Optional[Any] = None,
     mesh: Optional[Any] = None,
     param_specs: Optional[Any] = None,
 ):
@@ -159,6 +162,26 @@ def build_aiohttp_app(
     counters (faults injected/observed, rebuilds, recovered vs failed
     requests, quarantines, watchdog trips) surface under ``GET /stats`` →
     ``generation.robustness``.
+
+    ``generate_replicas`` > 1 serves a FLEET
+    (:class:`~unionml_tpu.serving.fleet.EngineFleet`): ``generator`` must
+    then be a callable returning a bare ``DecodeEngine`` — it is invoked once
+    per replica (receiving ``replica=i`` when its signature accepts it, so a
+    factory can place each engine on its own sub-mesh; see
+    :func:`~unionml_tpu.serving.fleet.split_mesh`) — or a prebuilt
+    ``EngineFleet``. Requests route by prefix affinity, session stickiness
+    (``/generate`` payloads may carry a ``session_id`` string), and
+    load/health (``generate_fleet_config``, a
+    :class:`~unionml_tpu.serving.fleet.FleetConfig`, tunes the router);
+    ``/healthz`` and ``/stats`` → ``generation.fleet`` report per-replica
+    state. Fleet replicas are always supervised (failover depends on it), so
+    ``generate_supervisor=False`` is rejected, and ``generate_scheduler``
+    must be a config, not a prebuilt scheduler instance.
+
+    ``retry_jitter_rng`` (a ``random.Random``) seeds the ±25% Retry-After
+    jitter on shed responses — by default a module-global RNG (production:
+    de-correlated retries); a seeded instance makes shed envelopes
+    reproducible for tests and A/B harnesses.
     """
     from aiohttp import web
 
@@ -200,30 +223,76 @@ def build_aiohttp_app(
             # graftlint: disable=async-blocking -- startup hook: the warmup compile+hard_sync runs before the server accepts any traffic, so blocking the (idle) loop here is the point
             predictor.setup()
         if generator is not None:
+            import inspect
+
             from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+            from unionml_tpu.serving.fleet import EngineFleet
+            from unionml_tpu.serving.scheduler import SLOScheduler
             from unionml_tpu.serving.supervisor import EngineSupervisor
 
-            built = generator() if callable(generator) and not isinstance(
-                generator, (DecodeEngine, ContinuousBatcher)
-            ) else generator
-            if generate_prefix_cache_blocks:
-                target = built.engine if isinstance(built, ContinuousBatcher) else built
-                if isinstance(target, DecodeEngine) and target.prefix_cache is None:
+            def _enable_cache(target):
+                if (
+                    generate_prefix_cache_blocks
+                    and isinstance(target, DecodeEngine)
+                    and target.prefix_cache is None
+                ):
                     target.enable_prefix_cache(
                         generate_prefix_cache_blocks, generate_prefix_block_size
                     )
-            if isinstance(built, DecodeEngine):
-                # supervision is ON by default for app-owned batchers: engine
-                # failures recover instead of failing the house (False opts out)
-                supervisor = generate_supervisor
-                if supervisor is None:
-                    supervisor = EngineSupervisor()
-                elif supervisor is False:
-                    supervisor = None
-                built = ContinuousBatcher(
-                    built, lookahead=generate_lookahead, scheduler=generate_scheduler,
-                    supervisor=supervisor,
+
+            prebuilt = isinstance(generator, (DecodeEngine, ContinuousBatcher, EngineFleet))
+            if generate_replicas > 1 and not prebuilt:
+                # fleet mode: the factory builds one bare engine per replica
+                # (each on its own sub-mesh when the factory takes `replica`)
+                if generate_supervisor is not None:
+                    # False would disable the failover layer the fleet is
+                    # built on; a single prebuilt supervisor can't be shared
+                    # across replicas (pass supervisors= to EngineFleet)
+                    raise ValueError(
+                        "generate_replicas > 1 builds one supervisor per "
+                        "replica; generate_supervisor must be left None"
+                    )
+                if isinstance(generate_scheduler, SLOScheduler):
+                    raise ValueError(
+                        "generate_replicas > 1 needs a SchedulerConfig (each "
+                        "replica owns its own scheduler), not an SLOScheduler"
+                    )
+                takes_replica = "replica" in inspect.signature(generator).parameters
+                engines = []
+                for i in range(int(generate_replicas)):
+                    engine = generator(replica=i) if takes_replica else generator()
+                    if not isinstance(engine, DecodeEngine):
+                        raise TypeError(
+                            f"fleet generator must return a DecodeEngine per "
+                            f"replica, got {type(engine)!r}"
+                        )
+                    _enable_cache(engine)
+                    engines.append(engine)
+                built = EngineFleet(
+                    engines,
+                    config=generate_fleet_config,
+                    lookahead=generate_lookahead,
+                    scheduler=generate_scheduler,
                 )
+            else:
+                built = generator() if callable(generator) and not prebuilt else generator
+                if isinstance(built, EngineFleet):
+                    for rep in built.replicas:
+                        _enable_cache(rep.engine)
+                else:
+                    _enable_cache(built.engine if isinstance(built, ContinuousBatcher) else built)
+                if isinstance(built, DecodeEngine):
+                    # supervision is ON by default for app-owned batchers: engine
+                    # failures recover instead of failing the house (False opts out)
+                    supervisor = generate_supervisor
+                    if supervisor is None:
+                        supervisor = EngineSupervisor()
+                    elif supervisor is False:
+                        supervisor = None
+                    built = ContinuousBatcher(
+                        built, lookahead=generate_lookahead, scheduler=generate_scheduler,
+                        supervisor=supervisor,
+                    )
             app["continuous_batcher"] = built
         logger.info("Serving app ready (model=%s).", model.name)
 
@@ -259,6 +328,13 @@ def build_aiohttp_app(
         this replica instead of timing out against it. Apps without a
         supervised generator report on the model artifact alone."""
         gen = request.app.get("continuous_batcher")
+        if gen is not None and getattr(gen, "is_fleet", False):
+            # fleet shape: per-replica supervisor states; the fleet serves
+            # (200) while ANY replica does — "degraded" flags reduced capacity
+            body = gen.healthz()
+            return web.json_response(
+                body, status=200 if body["state"] in ("ok", "degraded") else 503
+            )
         sup = getattr(gen, "supervisor", None) if gen is not None else None
         if sup is None:
             state = "ok" if model.artifact is not None else "failed"
@@ -330,13 +406,17 @@ def build_aiohttp_app(
         ``retry_after_ms`` (and the ``Retry-After`` header) carry ±25% JITTER:
         a shed wave handed one exact retry delay would come back as a
         synchronized thundering herd — the spread de-correlates the retries.
+        The jitter draws from ``retry_jitter_rng`` when the app was built
+        with one (seeded tests assert exact envelopes); default stays the
+        module-global RNG.
         """
         import random
 
         error = {"code": int(status), "reason": reason, "detail": detail}
         headers = {}
         if retry_after_s:
-            jittered = float(retry_after_s) * (0.75 + 0.5 * random.random())
+            draw = retry_jitter_rng.random if retry_jitter_rng is not None else random.random
+            jittered = float(retry_after_s) * (0.75 + 0.5 * draw())
             error["retry_after_ms"] = int(jittered * 1000)
             headers["Retry-After"] = str(max(1, round(jittered)))
         return web.json_response({"error": error}, status=status, headers=headers)
@@ -441,6 +521,15 @@ def build_aiohttp_app(
             ):
                 return _bad_request(f"deadline_ms must be a positive number, got {deadline_ms!r}")
             slo["deadline_ms"] = float(deadline_ms)
+        if payload.get("session_id") is not None:
+            session_id = payload["session_id"]
+            if not isinstance(session_id, str) or not session_id:
+                return _bad_request(f"session_id must be a non-empty string, got {session_id!r}")
+            # session stickiness is a fleet-router concept; forwarded only to
+            # a fleet generator (a single batcher has no session kwarg, and a
+            # sessionless deployment should not reject the field)
+            if getattr(gen, "is_fleet", False):
+                slo["session_id"] = session_id
 
         # optional per-request sampling controls (applied to every prompt in a
         # batch); absent keys defer to the engine's construction-time settings
@@ -556,7 +645,11 @@ def build_aiohttp_app(
             # server-side device latency (dispatch + fetch), split from HTTP RTT
             payload["device_latency"] = predictor.device_stats()
         gen = request.app.get("continuous_batcher")
-        if gen is not None:
+        if gen is not None and getattr(gen, "is_fleet", False):
+            # fleet shape: aggregate counters + generation.fleet with the
+            # router block and per-replica scheduler/supervisor/cache state
+            payload["generation"] = gen.stats()
+        elif gen is not None:
             # every generator kind (continuous engine, speculative facade)
             # surfaces the same counter set; getattr defaults keep the route
             # total even for a custom generator exposing only the core triple

@@ -82,7 +82,7 @@ import asyncio
 import dataclasses
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -2042,6 +2042,11 @@ class ContinuousBatcher:
         #: mutation on one thread
         self._orphans: List[Any] = []  # guarded-by: _lock
         self._worker: Optional[threading.Thread] = None
+        #: fleet hand-off hook: called (worker thread) with this batcher's
+        #: orphaned tickets when rebuild exhaustion leaves the engine dead;
+        #: returns the tickets it could NOT place elsewhere, which then fail
+        #: with the structured unavailable error. None = no fleet (all fail).
+        self.on_tickets_orphaned: Optional[Callable[[List[Any]], Sequence[Any]]] = None
 
     @property
     def engine(self) -> DecodeEngine:
@@ -2085,6 +2090,29 @@ class ContinuousBatcher:
             # fail it fast with the structured shed error (sink delivery is
             # thread-safe; displaced tickets are never resumes, so no pin)
             self._deliver(displaced.sink, "fail", displaced.shed_exc)
+        self._ensure_worker()
+        self._work.set()
+
+    def adopt_ticket(self, ticket: Any) -> None:
+        """Adopt another batcher's orphaned ticket (fleet failover).
+
+        The ticket arrives re-routed from a replica whose rebuild budget
+        exhausted: its prompt is already the full transcript, its budget the
+        unspent remainder, its deadline/priority/sink untouched, and its
+        salvage pin released (pins never cross engines — this engine pays a
+        fresh prefill, shortened by whatever prefix its own cache holds).
+        Sinks are loop-bound, not engine-bound, so delivery continues
+        seamlessly. Requeues through the scheduler's salvage path (bypassing
+        the admission bound — the work is already partially paid for) and
+        raises :class:`~unionml_tpu.serving.faults.EngineFailure` when this
+        batcher is closed, so the caller can try the next survivor.
+        """
+        prompt = np.asarray(ticket.prompt, dtype=np.int32).reshape(-1)
+        self._engine.bucket_for(prompt.size)  # unroutable here -> caller tries elsewhere
+        with self._lock:
+            if self._closed:
+                raise EngineFailure("batcher is closed", reason="batcher_closed")
+            self.scheduler.requeue(ticket, preemption=False)
         self._ensure_worker()
         self._work.set()
 
@@ -2438,15 +2466,33 @@ class ContinuousBatcher:
             sup.note_rebuilt()  # the engine already rebuilt itself in place
             rebuilt = True
         if not rebuilt:
-            unavailable = sup.unavailable_error()
+            # this engine is dead for good. Every ticket's salvage pin points
+            # into THIS engine's block pool — a hand-off target can restore
+            # nothing from it, and the pins must not outlive the replica — so
+            # release them all; the transcript-as-prompt (set above) already
+            # carries everything a resume needs on another engine.
+            orphans: List[Any] = []
             for meta in resumes:
                 if meta.resume is not None:
                     engine.release_preempted(meta.resume)
                     meta.resume = None
-                sup.note_request_failed()
-                self._deliver(meta.sink, "fail", unavailable)
+                orphans.append(meta)
             for ticket in list(pending) + self.scheduler.drain():
                 self._release_ticket(ticket)
+                orphans.append(ticket)
+            handoff = self.on_tickets_orphaned
+            unplaced: Sequence[Any] = orphans
+            if handoff is not None and orphans:
+                try:
+                    unplaced = list(handoff(orphans))
+                except Exception:
+                    logger.exception("orphaned-ticket hand-off failed; failing all tickets")
+                    unplaced = orphans
+            placed = len(orphans) - len(unplaced)
+            if placed > 0:
+                sup.note_recovered(placed)
+            unavailable = sup.unavailable_error()
+            for ticket in unplaced:
                 sup.note_request_failed()
                 self._deliver(ticket.sink, "fail", unavailable)
             return

@@ -27,7 +27,52 @@ hold WHAT tokens and WHEN a block may be reused.
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "block_key", "prefix_digests"]
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_FNV_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def block_key(tokens: Sequence[int], block_index: int, block_size: int) -> Tuple[int, ...]:
+    """The radix key of block ``block_index`` of ``tokens``: the tuple of that
+    block's token ids. This is THE prefix-cache hashing — the tree's node keys
+    (:meth:`PrefixCache._key_at`) and the fleet router's affinity digests
+    (:func:`prefix_digests`) both derive from it, so the two can never disagree
+    about which prompts share a cached block."""
+    start = block_index * block_size
+    return tuple(int(t) for t in tokens[start : start + block_size])
+
+
+def prefix_digests(
+    tokens: Sequence[int], block_size: int, max_blocks: Optional[int] = None
+) -> List[int]:
+    """Chained 64-bit FNV-1a digests of ``tokens``' block-aligned prefixes.
+
+    ``digests[i]`` summarizes blocks ``0..i`` (each via :func:`block_key`), and
+    each digest folds in its predecessor, so equal digests mean equal whole
+    *prefixes* — exactly the property a router needs to guess which replica's
+    radix tree holds a prompt's longest cached chain without shipping token
+    ids around. Deterministic across processes (unlike ``hash()``), cheap
+    (pure host integer math), and block-granular like the tree itself: a
+    prompt shorter than one block has no digest and no affinity.
+    """
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    total = len(tokens) // block_size
+    if max_blocks is not None:
+        total = min(total, max_blocks)
+    digests: List[int] = []
+    acc = _FNV_OFFSET
+    for index in range(total):
+        for tok in block_key(tokens, index, block_size):
+            # mix each token id byte-wise so nearby ids diverge fully
+            val = int(tok) & _FNV_MASK
+            for _ in range(8):
+                acc = ((acc ^ (val & 0xFF)) * _FNV_PRIME) & _FNV_MASK
+                val >>= 8
+        digests.append(acc)
+    return digests
 
 
 class _Node:
@@ -84,8 +129,7 @@ class PrefixCache:
         return self.num_blocks - len(self._free)
 
     def _key_at(self, tokens: Sequence[int], block_index: int) -> Tuple[int, ...]:
-        start = block_index * self.block_size
-        return tuple(int(t) for t in tokens[start : start + self.block_size])
+        return block_key(tokens, block_index, self.block_size)
 
     def match(self, tokens: Sequence[int], max_blocks: int) -> List[_Node]:
         """Longest cached chain of full blocks covering ``tokens``, up to

@@ -213,6 +213,12 @@ class SLOScheduler:
         self.preemptions = 0  # guarded-by: _lock
         self.resumes = 0  # guarded-by: _lock
         self.queue_wait_ema_ms: Optional[float] = None  # guarded-by: _lock
+        # per-class queue-wait EMAs: interactive traffic should not inherit
+        # batch-class waits in the infeasible-deadline estimate, and a fleet
+        # router wants the class-resolved signal — guarded-by: _lock
+        self.queue_wait_ema_ms_by_class: Dict[str, Optional[float]] = {
+            name: None for name in PRIORITY_CLASSES
+        }
 
     # ------------------------------------------------------------------ intake
 
@@ -261,16 +267,22 @@ class SLOScheduler:
         now = time.monotonic() if now is None else now
         with self._lock:
             self.submitted += 1
+            # prefer the ticket's OWN class EMA (an interactive request should
+            # not be shed because batch work waited long); fall back to the
+            # global EMA until that class has observed a pop
+            wait_ema = self.queue_wait_ema_ms_by_class.get(class_name(ticket.priority))
+            if wait_ema is None:
+                wait_ema = self.queue_wait_ema_ms
             if (
                 self.config.shed_infeasible
                 and ticket.deadline is not None
-                and self.queue_wait_ema_ms is not None
-                and self.queue_wait_ema_ms / 1e3 > ticket.deadline - now
+                and wait_ema is not None
+                and wait_ema / 1e3 > ticket.deadline - now
             ):
                 self.shed_deadline_infeasible += 1
                 raise DeadlineInfeasibleError(
                     f"deadline {round((ticket.deadline - now) * 1e3)}ms is below the "
-                    f"current queue wait (~{round(self.queue_wait_ema_ms)}ms)",
+                    f"current queue wait (~{round(wait_ema)}ms)",
                     retry_after_s=self.config.retry_after_s,
                 )
             displaced: Optional[Ticket] = None
@@ -375,11 +387,16 @@ class SLOScheduler:
         """Account one admission (the ticket is already off the queue)."""
         wait_ms = max(0.0, (now - ticket.enqueued) * 1e3)
         ticket.queue_wait_ms = wait_ms
+        cls = class_name(ticket.priority)
         with self._lock:
             self.queue_wait_ema_ms = (
                 wait_ms
                 if self.queue_wait_ema_ms is None
                 else 0.8 * self.queue_wait_ema_ms + 0.2 * wait_ms
+            )
+            prev = self.queue_wait_ema_ms_by_class.get(cls)
+            self.queue_wait_ema_ms_by_class[cls] = (
+                wait_ms if prev is None else 0.8 * prev + 0.2 * wait_ms
             )
             self.admitted += 1
             if ticket.resume is not None:
@@ -431,6 +448,18 @@ class SLOScheduler:
         with self._lock:
             return len(self._queued)
 
+    def load_signal(self) -> Dict[str, Any]:
+        """The routing signal a fleet router reads per candidate replica:
+        queue depth plus the global and per-class queue-wait EMAs, taken in
+        one lock hold so the numbers are mutually consistent. Cheap enough
+        to call on every route decision (host ints/floats only)."""
+        with self._lock:
+            return {
+                "depth": len(self._queued),
+                "queue_wait_ema_ms": self.queue_wait_ema_ms,
+                "per_class": dict(self.queue_wait_ema_ms_by_class),
+            }
+
     def stats(self) -> Dict[str, Any]:
         """The ``GET /stats`` → ``generation.scheduler`` block: per-class
         queue depth, queue-wait EMA, shed / preemption / deadline-miss
@@ -447,6 +476,10 @@ class SLOScheduler:
                 "queue_wait_ema_ms": None
                 if self.queue_wait_ema_ms is None
                 else round(self.queue_wait_ema_ms, 3),
+                "per_class": {
+                    name: None if ema is None else round(ema, 3)
+                    for name, ema in self.queue_wait_ema_ms_by_class.items()
+                },
                 "submitted": self.submitted,
                 "admitted": self.admitted,
                 "shed_queue_full": self.shed_queue_full,

@@ -94,6 +94,30 @@ class EngineSupervisor:
         self._engine: Optional[Any] = None
         self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # state-transition subscribers (a fleet router re-weighting replicas);
+        # append-only before traffic starts, so reads need no lock
+        self._subscribers: List[Callable[[str, str], None]] = []
+
+    def subscribe(self, callback: Callable[[str, str], None]) -> None:
+        """Register ``callback(old_state, new_state)``, fired on every health
+        transition — OUTSIDE the supervisor lock, so a subscriber may read
+        supervisor state (or take its own locks) without deadlock. Callbacks
+        run on whichever thread drove the transition (worker/watchdog) and
+        must be cheap and exception-safe; an exception is logged and dropped.
+        Subscribe before attaching traffic: registration is not synchronized
+        against concurrent transitions."""
+        self._subscribers.append(callback)
+
+    def _notify(self, old: str, new: str) -> None:
+        # called OUTSIDE _lock by design (see subscribe) — a subscriber that
+        # queries this supervisor or locks a router must not deadlock
+        if old == new:
+            return
+        for callback in list(self._subscribers):
+            try:
+                callback(old, new)
+            except Exception:
+                logger.exception("supervisor state subscriber failed (%s -> %s)", old, new)
 
     # ------------------------------------------------------------------ health
 
@@ -140,8 +164,11 @@ class EngineSupervisor:
             self.failures += 1
             self._failure_at = self._time()
             self._record_fault(self.classify(exc), str(exc))
+            old = self._state
             if self._state != "failed":
                 self._state = "rebuilding"
+            new = self._state
+        self._notify(old, new)
         logger.warning("engine failure (%s): entering recovery", self.classify(exc))
 
     def run_rebuild(self, rebuild: Callable[[], None]) -> bool:
@@ -170,12 +197,16 @@ class EngineSupervisor:
                 continue
             with self._lock:
                 self.rebuilds += 1
+                old = self._state
                 self._state = "ok"
                 self._note_recovery_time()
+            self._notify(old, "ok")
             logger.info("engine rebuilt (attempt %d/%d)", attempt, self.max_rebuild_attempts)
             return True
         with self._lock:
+            old = self._state
             self._state = "failed"
+        self._notify(old, "failed")
         logger.error(
             "engine rebuild exhausted %d attempts; supervisor state FAILED",
             self.max_rebuild_attempts,
@@ -192,9 +223,12 @@ class EngineSupervisor:
         common case): count it and return to ``ok`` without a retry loop."""
         with self._lock:
             self.rebuilds += 1
+            old = self._state
             if self._state == "rebuilding":
                 self._state = "ok"
+            new = self._state
             self._note_recovery_time()
+        self._notify(old, new)
 
     def note_recovered(self, n: int = 1) -> None:
         """Count requests checkpoint-resumed across a rebuild."""
@@ -250,6 +284,7 @@ class EngineSupervisor:
             busy and heartbeat is not None and (now - heartbeat) > self.stall_timeout_s
         )
         with self._lock:
+            old = self._state
             if stalled and not self._stalled:
                 self._stalled = True
                 self.watchdog_trips += 1
@@ -264,6 +299,8 @@ class EngineSupervisor:
                 self._stalled = False
                 if self._state == "degraded":
                     self._state = "ok"
+            new = self._state
+        self._notify(old, new)
         return stalled
 
     def close(self) -> None:
