@@ -678,6 +678,87 @@ def bench_pipeline(modes=("on", "off"), n_requests: int = 8, max_new_tokens: int
     return out
 
 
+def bench_obs(modes=("on", "off"), n_requests: int = 16, max_new_tokens: int = 32,
+              repeats: int = 3, mesh_devices: int = 0):
+    """Telemetry ON-vs-OFF A/B: the same concurrent request mix through the
+    asyncio batcher with the span/metrics subsystem attached vs absent
+    (``bench_serving.py --obs {on,off,ab}``).
+
+    The telemetry contract is "zero new host↔device syncs, one host branch
+    per hook when disabled": decode timing piggybacks on the fused deferred
+    fetch's existing stamps, and every recording site is lock-leaf host
+    arithmetic. This phase puts a number on that claim — best-of-``repeats``
+    decode tok/s per arm (best-of because the CPU smoke arm is scheduler-
+    noisy; a real regression shifts the best, noise only shifts the mean) —
+    and the ``ab`` entry point GATES at 2%: enabled throughput below 0.98×
+    disabled fails the battery step.
+    """
+    import asyncio
+
+    config, model, variables = _bench_gpt()
+    mesh = _serving_mesh(mesh_devices, config.num_heads) if mesh_devices else None
+
+    from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
+    from unionml_tpu.serving.telemetry import Telemetry
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config.vocab_size, size=6).tolist() for _ in range(n_requests)]
+
+    def run_once(enabled: bool):
+        telemetry = Telemetry() if enabled else None
+        engine = DecodeEngine(
+            model, variables, num_slots=min(8, n_requests), max_len=128,
+            prefill_buckets=(8,), mesh=mesh,
+        )
+        batcher = ContinuousBatcher(engine, telemetry=telemetry)
+
+        async def drive():
+            await batcher.generate(prompts[0], 4)  # warm the prefill/decode programs
+            base = engine.tokens_decoded
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(batcher.generate(p, max_new_tokens) for p in prompts)
+            )
+            elapsed = time.perf_counter() - t0
+            return engine.tokens_decoded - base, elapsed
+
+        try:
+            decoded, elapsed = asyncio.run(drive())
+        finally:
+            batcher.close()
+        entry = {
+            "decode_tok_s": round(decoded / elapsed, 1),
+            "total_s": round(elapsed, 4),
+            "tokens": decoded,
+        }
+        if telemetry is not None:
+            tstats = telemetry.stats()
+            entry["traces_completed"] = tstats["completed_traces"]
+            entry["spans_dropped"] = tstats["spans_dropped"]
+            # spans per trace: the per-request record cost the ring amortizes
+            traces = telemetry.recent(n_requests + 1)
+            entry["spans_per_trace"] = round(
+                sum(len(t["spans"]) for t in traces) / max(len(traces), 1), 1
+            )
+        return entry
+
+    out = {
+        "n_requests": n_requests,
+        "max_new_tokens": max_new_tokens,
+        "repeats": repeats,
+        "mesh_devices": mesh_devices or 1,
+    }
+    for mode in modes:
+        runs = [run_once(mode == "on") for _ in range(repeats)]
+        best = max(runs, key=lambda r: r["decode_tok_s"])
+        out["obs_" + mode] = dict(best, runs_tok_s=[r["decode_tok_s"] for r in runs])
+    if "obs_on" in out and "obs_off" in out:
+        on_best = out["obs_on"]["decode_tok_s"]
+        off_best = out["obs_off"]["decode_tok_s"]
+        out["overhead_frac"] = round(1.0 - on_best / max(off_best, 1e-9), 4)
+    return out
+
+
 def bench_slo_mix(n_batch: int = 24, n_interactive: int = 8, num_slots: int = 4,
                   batch_tokens: int = 48, interactive_tokens: int = 8,
                   interactive_deadline_ms: float = 30_000.0, mesh_devices: int = 0):
@@ -1128,6 +1209,14 @@ def main():
                         "decode tok/s, per-class p99 TTFT, and the router-level "
                         "prefix-affinity vs random-routing cold hit-rate A/B. Runs "
                         "ONLY this phase (like --slo-mix)")
+    parser.add_argument("--obs", choices=("on", "off", "ab"), default=None,
+                        help="focused telemetry-overhead phase: the same concurrent "
+                        "request mix through the asyncio batcher with span tracing + "
+                        "metrics ON vs OFF, best-of-3 decode tok/s per arm ('ab' runs "
+                        "the pair and GATES: enabled below 0.98x disabled exits "
+                        "nonzero — the zero-overhead hook contract, measured). Runs "
+                        "ONLY this phase (like --pipeline); combine with --mesh N for "
+                        "the sharded engine")
     parser.add_argument("--pipeline", choices=("on", "off", "ab"), default=None,
                         help="focused depth-1 pipelined-decode phase: decode tok/s + "
                         "host-gap ms at lookahead=1 with dispatch-ahead on/off "
@@ -1149,12 +1238,14 @@ def main():
     from bench_util import resolve_artifact_path
 
     backend = jax.default_backend()
-    if args.pipeline or args.mesh or args.slo_mix or args.chaos or args.fleet:
+    if args.pipeline or args.mesh or args.slo_mix or args.chaos or args.fleet or args.obs:
         import os
 
         base, ext = os.path.splitext(args.out)
         if args.pipeline:
             base = f"{base}_pipeline"
+        if args.obs:
+            base = f"{base}_obs"
         if args.slo_mix:
             base = f"{base}_slo"
         if args.chaos:
@@ -1238,6 +1329,31 @@ def main():
         with open(args.out, "w") as fh:
             json.dump(results, fh, indent=2)
         print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        return 0
+
+    if args.obs:
+        if args.mesh and len(jax.devices()) < args.mesh:
+            print(json.dumps({"metric": "obs_decode_tok_s",
+                              "error": f"--mesh {args.mesh} needs {args.mesh} devices, "
+                              f"found {len(jax.devices())}", "backend": backend}))
+            return 1
+        modes = ("on", "off") if args.obs == "ab" else (args.obs,)
+        ab = bench_obs(modes=modes, mesh_devices=args.mesh)
+        results["models"]["obs_ab" if len(modes) == 2 else f"obs_{modes[0]}"] = ab
+        line = {"metric": "obs_decode_tok_s", "backend": backend,
+                "mesh_devices": args.mesh or 1}
+        for mode in modes:
+            line[f"tok_s_{mode}"] = ab[f"obs_{mode}"]["decode_tok_s"]
+        if len(modes) == 2:
+            line["overhead_frac"] = ab["overhead_frac"]
+        print(json.dumps(line))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        # the A/B GATES at 2%: telemetry hooks must stay effectively free on
+        # the decode hot path — a bigger regression fails the battery step
+        if len(modes) == 2 and ab["overhead_frac"] > 0.02:
+            return 1
         return 0
 
     if args.pipeline:

@@ -92,6 +92,11 @@ run serving_mesh 420 python bench_serving.py --mesh 4
 # depth-1 pipelined decode A/B: dispatch-ahead on vs off at lookahead=1 —
 # decode tok/s + host-gap ms (the host sync this battery's tunnel magnifies)
 run serving_pipeline 300 python bench_serving.py --pipeline ab
+# telemetry overhead A/B: span tracing + metrics on vs off over the same
+# concurrent mix — best-of-3 decode tok/s per arm (the phase exits nonzero
+# when the enabled arm regresses more than 2%, holding the zero-overhead
+# hook contract on real hardware)
+run serving_obs 300 python bench_serving.py --obs ab
 # SLO scheduler A/B: mixed interactive+batch load, scheduler vs FIFO —
 # per-class TTFT p50/p95/p99 + shed/preempt/deadline-miss counts
 run serving_slo 300 python bench_serving.py --slo-mix

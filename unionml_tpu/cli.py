@@ -289,6 +289,23 @@ def fetch_predictions(app: str, app_version: Optional[str], prediction_id: str, 
     "app to define a generator factory; >1 enables /generate session "
     "routing and failover).",
 )
+@click.option(
+    "--telemetry/--no-telemetry",
+    "telemetry",
+    default=True,
+    show_default=True,
+    help="Per-request span tracing + the Prometheus /metrics, "
+    "/trace/{request_id}, and /traces/recent endpoints on the generation "
+    "path (off: the request path pays one host branch per hook and "
+    "nothing else).",
+)
+@click.option(
+    "--trace-journal",
+    default=None,
+    type=click.Path(path_type=Path),
+    help="Append completed request traces to this JSONL file (schema v1; "
+    "the replay-simulator input). Implies --telemetry.",
+)
 def serve(
     app: str,
     model_path: Optional[Path],
@@ -298,6 +315,8 @@ def serve(
     app_version: Optional[str],
     model_version: str,
     replicas: int,
+    telemetry: bool,
+    trace_journal: Optional[Path],
 ) -> None:
     """Serve the model over HTTP with a resident compiled predictor."""
     if model_path is not None:
@@ -310,6 +329,10 @@ def serve(
     serving_kwargs = {}
     if replicas > 1:
         serving_kwargs["generate_replicas"] = replicas
+    if trace_journal is not None:
+        telemetry = True
+        serving_kwargs["generate_trace_journal"] = str(trace_journal)
+    serving_kwargs["generate_telemetry"] = telemetry
     http_app = serving_app(
         model, remote=remote, app_version=app_version, model_version=model_version,
         **serving_kwargs,

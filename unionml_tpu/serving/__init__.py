@@ -6,10 +6,12 @@ from unionml_tpu.serving.app import build_aiohttp_app, jsonable, load_model_arti
 from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
 from unionml_tpu.serving.faults import EngineFailure, FaultError, FaultPlan
 from unionml_tpu.serving.fleet import EngineFleet, FleetConfig, Router, split_mesh
+from unionml_tpu.serving.metrics import MetricsRegistry
 from unionml_tpu.serving.prefix_cache import PrefixCache
 from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
 from unionml_tpu.serving.speculative import SpeculativeBatcher
 from unionml_tpu.serving.supervisor import EngineSupervisor
+from unionml_tpu.serving.telemetry import Telemetry
 from unionml_tpu.serving.resident import ResidentPredictor
 
 
@@ -69,11 +71,13 @@ __all__ = [
     "FaultError",
     "FaultPlan",
     "FleetConfig",
+    "MetricsRegistry",
     "PrefixCache",
     "ResidentPredictor",
     "Router",
     "SLOScheduler",
     "SchedulerConfig",
+    "Telemetry",
     "split_mesh",
     "build_aiohttp_app",
     "jsonable",

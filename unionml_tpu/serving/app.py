@@ -96,6 +96,8 @@ def build_aiohttp_app(
     generate_drain_s: float = 5.0,
     generate_replicas: int = 1,
     generate_fleet_config: Optional[Any] = None,
+    generate_telemetry: Any = True,
+    generate_trace_journal: Optional[str] = None,
     retry_jitter_rng: Optional[Any] = None,
     mesh: Optional[Any] = None,
     param_specs: Optional[Any] = None,
@@ -178,6 +180,20 @@ def build_aiohttp_app(
     ``generate_supervisor=False`` is rejected, and ``generate_scheduler``
     must be a config, not a prebuilt scheduler instance.
 
+    ``generate_telemetry`` wires the serving telemetry subsystem
+    (:class:`~unionml_tpu.serving.telemetry.Telemetry`) into the generator at
+    startup: per-request span traces (``GET /trace/{request_id}``,
+    ``GET /traces/recent``), Prometheus metrics (``GET /metrics``), and a
+    ``telemetry`` block under ``GET /stats`` that solo and fleet deployments
+    share. ``True`` (default) builds one; pass a prebuilt ``Telemetry`` to
+    share instruments with a harness, or ``False``/``None`` to disable — the
+    request path then pays one host ``is not None`` branch per hook site and
+    nothing else. ``generate_trace_journal`` names a JSONL file completed
+    traces append to (schema v1; the replay-simulator input). Every
+    ``/generate`` request is assigned a ``request_id`` (echoed in the
+    response, in error envelopes, and in request-path log lines) that keys
+    its trace.
+
     ``retry_jitter_rng`` (a ``random.Random``) seeds the ±25% Retry-After
     jitter on shed responses — by default a module-global RNG (production:
     de-correlated retries); a seeded instance makes shed envelopes
@@ -229,6 +245,15 @@ def build_aiohttp_app(
             from unionml_tpu.serving.fleet import EngineFleet
             from unionml_tpu.serving.scheduler import SLOScheduler
             from unionml_tpu.serving.supervisor import EngineSupervisor
+            from unionml_tpu.serving.telemetry import Telemetry
+
+            telemetry = None
+            if generate_telemetry:
+                telemetry = (
+                    generate_telemetry
+                    if isinstance(generate_telemetry, Telemetry)
+                    else Telemetry(journal_path=generate_trace_journal)
+                )
 
             def _enable_cache(target):
                 if (
@@ -273,6 +298,7 @@ def build_aiohttp_app(
                     config=generate_fleet_config,
                     lookahead=generate_lookahead,
                     scheduler=generate_scheduler,
+                    telemetry=telemetry,
                 )
             else:
                 built = generator() if callable(generator) and not prebuilt else generator
@@ -291,8 +317,15 @@ def build_aiohttp_app(
                         supervisor = None
                     built = ContinuousBatcher(
                         built, lookahead=generate_lookahead, scheduler=generate_scheduler,
-                        supervisor=supervisor,
+                        supervisor=supervisor, telemetry=telemetry,
                     )
+            if telemetry is not None:
+                # prebuilt batchers/fleets get the same wiring post-hoc (no-op
+                # when the caller already attached one — theirs wins)
+                attach = getattr(built, "attach_telemetry", None)
+                if callable(attach):
+                    attach(telemetry)
+            app["telemetry"] = getattr(built, "_telemetry", None) or telemetry
             app["continuous_batcher"] = built
         logger.info("Serving app ready (model=%s).", model.name)
 
@@ -396,12 +429,16 @@ def build_aiohttp_app(
             logger.exception("Prediction failed")
             return web.json_response({"detail": f"Prediction failed: {exc}"}, status=500)
 
-    def _error_response(status, reason, detail, retry_after_s=None):
+    def _error_response(status, reason, detail, retry_after_s=None, request_id=None):
         """The ONE machine-readable error envelope every non-200 on this app
         uses — 400/429/500/503/504 all share it, so clients parse one shape:
 
             {"error": {"code": int, "reason": slug, "detail": str,
-                       "retry_after_ms": int?}}
+                       "retry_after_ms": int?, "request_id": str?}}
+
+        ``request_id`` (present on every ``/generate`` failure) keys the
+        request's span trace — ``GET /trace/{request_id}`` answers "what
+        happened to THIS request" for sheds and failures alike.
 
         ``retry_after_ms`` (and the ``Retry-After`` header) carry ±25% JITTER:
         a shed wave handed one exact retry delay would come back as a
@@ -413,6 +450,8 @@ def build_aiohttp_app(
         import random
 
         error = {"code": int(status), "reason": reason, "detail": detail}
+        if request_id is not None:
+            error["request_id"] = request_id
         headers = {}
         if retry_after_s:
             draw = retry_jitter_rng.random if retry_jitter_rng is not None else random.random
@@ -421,11 +460,11 @@ def build_aiohttp_app(
             headers["Retry-After"] = str(max(1, round(jittered)))
         return web.json_response({"error": error}, status=status, headers=headers)
 
-    def _bad_request(detail, reason="invalid_request"):
+    def _bad_request(detail, reason="invalid_request", request_id=None):
         """Client-side rejection: machine-readable ``reason`` + human detail."""
-        return _error_response(400, reason, detail)
+        return _error_response(400, reason, detail, request_id=request_id)
 
-    def _scheduling_response(exc):
+    def _scheduling_response(exc, request_id=None):
         """Map a structured scheduling rejection to its HTTP contract:
         queue-full sheds are 429, infeasible-deadline sheds are 503 (both with
         jittered ``Retry-After``), and deadline expiry is 504 — each carrying
@@ -448,9 +487,10 @@ def build_aiohttp_app(
         return _error_response(
             status, getattr(exc, "reason", "scheduling"), str(exc),
             retry_after_s=getattr(exc, "retry_after_s", None),
+            request_id=request_id,
         )
 
-    def _engine_failure_response(exc):
+    def _engine_failure_response(exc, request_id=None):
         """An engine-side structured failure: 503 when a retry can plausibly
         succeed (rebuilding, transient fault — another replica, or this one in
         a moment), 500 when it cannot — either way the reason slug travels,
@@ -459,33 +499,48 @@ def build_aiohttp_app(
         return _error_response(
             503 if retryable else 500, getattr(exc, "reason", "engine_failure"), str(exc),
             retry_after_s=1.0 if retryable else None,
+            request_id=request_id,
         )
 
     async def generate_route(request):
         from unionml_tpu.serving.faults import EngineFailure
         from unionml_tpu.serving.scheduler import SchedulingError, parse_priority
+        from unionml_tpu.serving.telemetry import new_request_id
 
+        # minted at route entry so EVERY outcome — 400s included — carries an
+        # id the client can quote; for a single-prompt request the same id
+        # keys the span trace (GET /trace/{request_id})
+        request_id = new_request_id()
         gen = request.app.get("continuous_batcher")
         if gen is None:
-            return _error_response(404, "not_enabled", "Generation is not enabled on this app.")
+            return _error_response(
+                404, "not_enabled", "Generation is not enabled on this app.",
+                request_id=request_id,
+            )
         try:
             payload = await request.json()
         except Exception as exc:
-            return _bad_request(f"Request body must be JSON: {exc}", reason="invalid_json")
+            return _bad_request(
+                f"Request body must be JSON: {exc}", reason="invalid_json",
+                request_id=request_id,
+            )
         prompt_ids = payload.get("prompt_ids")
         prompts = payload.get("prompts")
         if prompt_ids is None and prompts is None:
-            return _bad_request("prompt_ids (one prompt) or prompts (a batch) must be supplied.")
+            return _bad_request(
+                "prompt_ids (one prompt) or prompts (a batch) must be supplied.",
+                request_id=request_id,
+            )
         import asyncio
 
         try:
             max_new = int(payload.get("max_new_tokens", 32))
         except (TypeError, ValueError):
-            return _bad_request("max_new_tokens must be an integer.")
+            return _bad_request("max_new_tokens must be an integer.", request_id=request_id)
         if max_new < 1:
             # pre-validated here so the streaming path can reject BEFORE
             # committing a 200 status line (the engine's check would be too late)
-            return _bad_request("max_new_tokens must be >= 1.")
+            return _bad_request("max_new_tokens must be >= 1.", request_id=request_id)
 
         try:
             # validate EVERY prompt before scheduling any: a bad prompt in a
@@ -500,7 +555,7 @@ def build_aiohttp_app(
                     raise ValueError(f"prompt length {seq.size} >= max_len ({gen.engine.max_len})")
                 gen.engine.bucket_for(seq.size)
         except (TypeError, ValueError) as exc:
-            return _bad_request(f"invalid prompt payload: {exc}")
+            return _bad_request(f"invalid prompt payload: {exc}", request_id=request_id)
 
         # optional SLO fields: a priority class and a wall-clock deadline
         # budget (ms, arrival -> completion), forwarded to the generator's
@@ -511,7 +566,7 @@ def build_aiohttp_app(
             try:
                 slo["priority"] = parse_priority(payload["priority"])
             except ValueError as exc:
-                return _bad_request(str(exc))
+                return _bad_request(str(exc), request_id=request_id)
         if payload.get("deadline_ms") is not None:
             deadline_ms = payload["deadline_ms"]
             if (
@@ -519,12 +574,18 @@ def build_aiohttp_app(
                 or not isinstance(deadline_ms, (int, float))
                 or deadline_ms <= 0
             ):
-                return _bad_request(f"deadline_ms must be a positive number, got {deadline_ms!r}")
+                return _bad_request(
+                    f"deadline_ms must be a positive number, got {deadline_ms!r}",
+                    request_id=request_id,
+                )
             slo["deadline_ms"] = float(deadline_ms)
         if payload.get("session_id") is not None:
             session_id = payload["session_id"]
             if not isinstance(session_id, str) or not session_id:
-                return _bad_request(f"session_id must be a non-empty string, got {session_id!r}")
+                return _bad_request(
+                    f"session_id must be a non-empty string, got {session_id!r}",
+                    request_id=request_id,
+                )
             # session stickiness is a fleet-router concept; forwarded only to
             # a fleet generator (a single batcher has no session kwarg, and a
             # sessionless deployment should not reject the field)
@@ -542,7 +603,7 @@ def build_aiohttp_app(
                 payload.get("top_p") if payload.get("top_p") is not None else 1.0,
             )
         except (TypeError, ValueError) as exc:
-            return _bad_request(f"invalid sampling params: {exc}")
+            return _bad_request(f"invalid sampling params: {exc}", request_id=request_id)
         sampling = {}
         if payload.get("temperature") is not None:
             sampling["temperature"] = temp
@@ -552,7 +613,17 @@ def build_aiohttp_app(
             sampling["top_p"] = top_p
         stream = bool(payload.get("stream"))
         if stream and prompt_ids is None:
-            return _bad_request("stream=true requires a single prompt_ids prompt.")
+            return _bad_request(
+                "stream=true requires a single prompt_ids prompt.", request_id=request_id
+            )
+        # forward the route's id into the generator's trace when it can carry
+        # it (single prompt only: each prompt of a batch opens its OWN trace,
+        # while the route-level id still identifies the HTTP request)
+        rid_kw = (
+            {"request_id": request_id}
+            if getattr(gen, "accepts_request_id", False)
+            else {}
+        )
         if stream:
             import contextlib
             import json as _json
@@ -561,7 +632,7 @@ def build_aiohttp_app(
             # scheduling rejections (queue full / infeasible or expired
             # deadline) surface as their real 429/503/504 statuses instead of
             # an in-band error on a 200 stream
-            stream_it = gen.stream(prompt_ids, max_new, **slo, **sampling)
+            stream_it = gen.stream(prompt_ids, max_new, **slo, **sampling, **rid_kw)
             exhausted, first = False, None
             try:
                 first = await anext(stream_it)
@@ -569,17 +640,19 @@ def build_aiohttp_app(
                 exhausted = True  # zero emitted tokens (e.g. immediate eos)
             except SchedulingError as exc:
                 await stream_it.aclose()
-                return _scheduling_response(exc)
+                return _scheduling_response(exc, request_id=request_id)
             except EngineFailure as exc:
                 await stream_it.aclose()
-                return _engine_failure_response(exc)
+                return _engine_failure_response(exc, request_id=request_id)
             except ValueError as exc:
                 await stream_it.aclose()
-                return _bad_request(str(exc))
+                return _bad_request(str(exc), request_id=request_id)
             except Exception as exc:
                 await stream_it.aclose()
-                logger.exception("Generation failed")
-                return _error_response(500, "internal", f"Generation failed: {exc}")
+                logger.exception("Generation failed (request_id=%s)", request_id)
+                return _error_response(
+                    500, "internal", f"Generation failed: {exc}", request_id=request_id
+                )
 
             # ndjson chunks: one {"token": N} line per decoded token, then a
             # {"done": true, "tokens": [...]} trailer. Failures from here on
@@ -604,8 +677,10 @@ def build_aiohttp_app(
                     (_json.dumps({"done": True, "tokens": tokens}) + "\n").encode()
                 )
             except Exception as exc:
-                logger.warning("Streaming generation ended early: %s", exc)
-                line = {"error": str(exc)}
+                logger.warning(
+                    "Streaming generation ended early (request_id=%s): %s", request_id, exc
+                )
+                line = {"error": str(exc), "request_id": request_id}
                 reason = getattr(exc, "reason", None)
                 if reason is not None:
                     # a deadline expiring (or the engine failing) mid-stream
@@ -623,21 +698,25 @@ def build_aiohttp_app(
             return response
         try:
             if prompt_ids is not None:
-                tokens = await gen.generate(prompt_ids, max_new, **slo, **sampling)
-                return web.json_response({"tokens": tokens})
+                tokens = await gen.generate(prompt_ids, max_new, **slo, **sampling, **rid_kw)
+                return web.json_response({"tokens": tokens, "request_id": request_id})
             completions = await asyncio.gather(
                 *(gen.generate(p, max_new, **slo, **sampling) for p in prompts)
             )
-            return web.json_response({"completions": list(completions)})
+            return web.json_response(
+                {"completions": list(completions), "request_id": request_id}
+            )
         except SchedulingError as exc:  # structured shed / deadline rejection
-            return _scheduling_response(exc)
+            return _scheduling_response(exc, request_id=request_id)
         except EngineFailure as exc:  # engine-side structured failure (recovery taxonomy)
-            return _engine_failure_response(exc)
+            return _engine_failure_response(exc, request_id=request_id)
         except ValueError as exc:  # bad request (empty/oversized prompt, bad budget)
-            return _bad_request(str(exc))
+            return _bad_request(str(exc), request_id=request_id)
         except Exception as exc:  # engine/worker failures are SERVER errors
-            logger.exception("Generation failed")
-            return _error_response(500, "internal", f"Generation failed: {exc}")
+            logger.exception("Generation failed (request_id=%s)", request_id)
+            return _error_response(
+                500, "internal", f"Generation failed: {exc}", request_id=request_id
+            )
 
     async def stats(request):
         payload = {"model": model.name, "resident": predictor is not None}
@@ -690,21 +769,75 @@ def build_aiohttp_app(
                 robustness.update(sup.stats())
             if robustness:
                 payload["generation"]["robustness"] = robustness
+        tel = request.app.get("telemetry")
+        if tel is not None:
+            # the ONE schema solo and fleet share: trace/journal state plus a
+            # snapshot of every registry instrument (the same counters the
+            # Prometheus /metrics endpoint renders), so a client reads one
+            # block whichever deployment shape is behind the route
+            payload["telemetry"] = {**tel.stats(), "metrics": tel.metrics.snapshot()}
         if batcher is not None:
             payload["coalescing"] = dict(batcher.stats)
             if batcher.ema_gap_ms is not None:
                 payload["coalescing"]["ema_gap_ms"] = round(batcher.ema_gap_ms, 3)
         return web.json_response(payload)
 
+    async def metrics_route(request):
+        """``GET /metrics``: Prometheus text exposition (format 0.0.4) of the
+        serving registry — one scrape target whichever generator shape
+        (solo engine, fleet) is behind the app."""
+        tel = request.app.get("telemetry")
+        if tel is None:
+            return _error_response(404, "not_enabled", "Telemetry is not enabled on this app.")
+        return web.Response(
+            body=tel.metrics.render().encode("utf-8"),
+            headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+        )
+
+    async def trace_route(request):
+        """``GET /trace/{request_id}``: the request's full span tree (active
+        or recently completed) — admission, queue wait, routing, prefix
+        restore, prefill chunks, decode, preemption/quarantine/failover, and
+        the terminal status."""
+        tel = request.app.get("telemetry")
+        if tel is None:
+            return _error_response(404, "not_enabled", "Telemetry is not enabled on this app.")
+        rid = request.match_info["request_id"]
+        trace = tel.get_trace(rid)
+        if trace is None:
+            return _error_response(
+                404, "trace_not_found",
+                f"no active or recent trace for request_id {rid!r} "
+                f"(the journal ring may have evicted it)",
+                request_id=rid,
+            )
+        return web.json_response(trace)
+
+    async def traces_recent(request):
+        """``GET /traces/recent?n=K``: the journal ring's most recent completed
+        traces, newest first (JSONL schema v1 objects)."""
+        tel = request.app.get("telemetry")
+        if tel is None:
+            return _error_response(404, "not_enabled", "Telemetry is not enabled on this app.")
+        try:
+            n = int(request.query.get("n", 50))
+        except (TypeError, ValueError):
+            return _bad_request("n must be an integer.")
+        return web.json_response({"traces": tel.recent(n)})
+
     app.router.add_get("/", index)
     app.router.add_get("/health", health)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/stats", stats)
+    app.router.add_get("/metrics", metrics_route)
+    app.router.add_get("/trace/{request_id}", trace_route)
+    app.router.add_get("/traces/recent", traces_recent)
     app.router.add_post("/predict", predict)
     app.router.add_post("/generate", generate_route)
     app["unionml_model"] = model
     app["resident_predictor"] = predictor
     app["request_batcher"] = batcher
+    app["telemetry"] = None  # set at startup when a generator is wired
     return app
 
 

@@ -230,6 +230,7 @@ class DecodeEngine:
         prefix_cache_generated: bool = False,
         pipeline: bool = True,
         faults: Optional[FaultPlan] = None,
+        telemetry: Optional[Any] = None,
     ) -> None:
         from unionml_tpu.models.gpt import init_cache
 
@@ -294,6 +295,16 @@ class DecodeEngine:
         #: deterministic fault-injection script (None in production: every
         #: hook is a single host ``is not None`` branch — zero device work)
         self._faults = faults
+        #: span/metrics collector (None = tracing off: every hook is the same
+        #: single host ``is not None`` branch as the fault hooks — no device
+        #: work, no host syncs; decode timing reuses the fused-fetch stamps)
+        self._telemetry = telemetry
+        if faults is not None and telemetry is not None and faults.telemetry is None:
+            faults.telemetry = telemetry
+        #: slot -> request_id of the occupant's trace (batcher-set); spans for
+        #: a slot emitted before the id binds are buffered and flushed at bind
+        self._slot_rid: Dict[int, str] = {}
+        self._slot_pending_spans: Dict[int, List[Tuple[str, float, Optional[float], Dict[str, Any]]]] = {}
         #: engine-failure incidents survived (the batcher keys recovery off a
         #: delta of this counter, like the old ``_resets`` check but precise)
         self.failure_count = 0
@@ -639,7 +650,7 @@ class DecodeEngine:
             raise ValueError(
                 f"prefix_block_size must be in [1, max_len) = [1, {self.max_len}), got {block_size}"
             )
-        self.prefix_cache = PrefixCache(int(num_blocks), block_size)
+        self.prefix_cache = PrefixCache(int(num_blocks), block_size, telemetry=self._telemetry)
         self.prefix_cache_generated = bool(cache_generated)
         self._prefix_block_size = block_size
         self._pool = init_block_pool(self._config, int(num_blocks), block_size)
@@ -934,6 +945,12 @@ class DecodeEngine:
                     self._activate(slot, int(lengths[r]), budget, temp, top_k, top_p)
                     self.prefill_tokens_computed += int(prompt.size)
                     self._index_prompt(slot, prompt)
+                    if self._telemetry is not None:
+                        self._telemetry.prefill_tokens_total.inc(float(prompt.size))
+                        self._note_span(
+                            slot, "prefill",
+                            tokens=int(prompt.size), bucket=int(bucket), batch_rows=rows,
+                        )
 
     def _defer_for_sibling(self, prompt: np.ndarray, sibling_prefixes: set) -> bool:
         """True when an earlier request in THIS admit_many call is about to
@@ -1036,6 +1053,10 @@ class DecodeEngine:
         self._activate(slot, int(prompt.size), budget, temp, top_k, top_p)
         self._slot_path[slot] = path
         self._index_prompt(slot, prompt)
+        if self._telemetry is not None:
+            self._telemetry.prefill_tokens_total.inc(float(suffix_len))
+            self._note_span(slot, "prefix_hit", matched_tokens=matched, blocks=len(path))
+            self._note_span(slot, "prefill", tokens=suffix_len, restored=matched)
         return True
 
     def _index_prompt(self, slot: int, prompt: np.ndarray) -> None:
@@ -1150,6 +1171,8 @@ class DecodeEngine:
             self.prefix_restore_dispatches += 1
             self.prefix_cache.record_hit(matched)
             self._slot_path[slot] = list(path)
+            if self._telemetry is not None:
+                self._note_span(slot, "prefix_hit", matched_tokens=matched, blocks=len(path))
         else:
             from unionml_tpu.models.gpt import init_cache
 
@@ -1190,12 +1213,22 @@ class DecodeEngine:
                     jnp.asarray(consumed, dtype=jnp.int32),
                 )
             except Exception as exc:  # this slot's local dispatch: fail it alone
-                logger.warning("chunked prefill failed for slot %d: %s", slot, exc)
+                rid = self._slot_rid.get(slot)
+                logger.warning(
+                    "chunked prefill failed for slot %d: %s%s",
+                    slot, exc, f" (request_id={rid})" if rid is not None else "",
+                )
                 self._fail_partial(slot)
                 continue
             self.prefill_dispatches += 1
             self.prefill_tokens_computed += int(take)
             state["consumed"] = consumed + take
+            if self._telemetry is not None:
+                self._telemetry.prefill_tokens_total.inc(float(take))
+                self._note_span(
+                    slot, "prefill_chunk",
+                    tokens=int(take), consumed=int(state["consumed"]), total=int(prompt.size),
+                )
             if state["consumed"] < prompt.size:
                 continue
             # final chunk: logits at the prompt's last REAL token seed decoding
@@ -1221,6 +1254,8 @@ class DecodeEngine:
         self._reserved[slot] = False
         self._slot_queue_wait.pop(slot, None)
         self._release_prefix(slot)
+        if self._telemetry is not None:
+            self._drop_rid(slot)
         self._pending_events.append(
             StepEvent(slot=slot, token=-1, emit=False, finished=True, error="prefill_failed")
         )
@@ -1264,6 +1299,8 @@ class DecodeEngine:
         self._lens_host[:] = 0
         self._remaining[:] = 0
         self._slot_queue_wait.clear()
+        self._slot_rid.clear()
+        self._slot_pending_spans.clear()
         self._slot_temp[:] = self.temperature
         self._slot_top_k[:] = 0
         self._slot_top_p[:] = 1.0
@@ -1412,6 +1449,8 @@ class DecodeEngine:
         self._lens_host[:] = 0
         self._remaining[:] = 0
         self._slot_queue_wait.clear()
+        self._slot_rid.clear()
+        self._slot_pending_spans.clear()
         self._slot_temp[:] = self.temperature
         self._slot_top_k[:] = 0
         self._slot_top_p[:] = 1.0
@@ -1457,6 +1496,8 @@ class DecodeEngine:
             if self.prefix_cache is not None and self.prefix_cache_generated:
                 self._capture_generated(slot)
             self._release_prefix(slot)
+            if self._telemetry is not None:
+                self._drop_rid(slot)
         return StepEvent(
             slot=slot, token=token, emit=not is_eos, finished=finished,
             queue_wait_ms=queue_wait_ms,
@@ -1520,7 +1561,13 @@ class DecodeEngine:
         """Record how long ``slot``'s request sat queued before admission (the
         batcher calls this right after ``admit_many``). The value rides on the
         slot's first :class:`StepEvent` and feeds the queue-wait EMA that
-        :meth:`pipeline_stats` (and ``GET /stats``) report."""
+        :meth:`pipeline_stats` (and ``GET /stats``) report.
+
+        .. deprecated:: PR-11
+            ``StepEvent.queue_wait_ms`` (populated only on the first token)
+            is kept for compatibility; the telemetry trace's ``queue_wait``
+            span is the one source of truth for TTFT decomposition.
+        """
         if wait_ms is None:
             return
         self._slot_queue_wait[slot] = float(wait_ms)
@@ -1529,6 +1576,34 @@ class DecodeEngine:
             if self.ema_queue_wait_ms is None
             else 0.8 * self.ema_queue_wait_ms + 0.2 * float(wait_ms)
         )
+
+    def note_request_id(self, slot: int, request_id: Optional[str]) -> None:
+        """Bind ``slot``'s occupant to its trace (batcher-set at registration,
+        right after :meth:`note_queue_wait`); flushes any spans the admission
+        path buffered for the slot before the id was known."""
+        if self._telemetry is None or request_id is None:
+            return
+        self._slot_rid[slot] = request_id
+        for kind, at, dur_ms, attrs in self._slot_pending_spans.pop(slot, ()):
+            self._telemetry.span(request_id, kind, dur_ms=dur_ms, at=at, **attrs)
+
+    def _note_span(self, slot: int, kind: str, dur_ms: Optional[float] = None, **attrs: Any) -> None:
+        """Record a slot-keyed span, buffering when the request id is not yet
+        bound (admission-time prefill spans precede batcher registration).
+        Callers gate on ``self._telemetry is not None`` (zero-cost-off)."""
+        rid = self._slot_rid.get(slot)
+        if rid is not None:
+            self._telemetry.span(rid, kind, dur_ms=dur_ms, **attrs)
+        else:
+            self._slot_pending_spans.setdefault(slot, []).append(
+                (kind, time.perf_counter(), dur_ms, attrs)
+            )
+
+    def _drop_rid(self, slot: int) -> None:
+        """Forget a retired slot's trace binding (the trace itself ends at the
+        batcher, which owns terminal delivery)."""
+        self._slot_rid.pop(slot, None)
+        self._slot_pending_spans.pop(slot, None)
 
     def _fetch_inflight(self) -> List[StepEvent]:
         """Fetch the dispatched-but-unfetched step (no-op when none) and replay
@@ -1575,6 +1650,8 @@ class DecodeEngine:
         )
         self._last_fetch_done = done
         events: List[StepEvent] = []
+        telemetry = self._telemetry
+        emitted: Dict[Optional[str], int] = {}
         for i in range(tokens_host.shape[0]):
             if masks_host[i].any():
                 # mirrors the in-program key gate (any(active) at step start):
@@ -1592,7 +1669,18 @@ class DecodeEngine:
                 if bads_host[i, slot]:
                     events.append(self._quarantine(slot))
                     continue
-                events.append(self._apply_token(slot, int(tokens_host[i, slot])))
+                rid = self._slot_rid.get(slot) if telemetry is not None else None
+                event = self._apply_token(slot, int(tokens_host[i, slot]))
+                events.append(event)
+                if telemetry is not None and event.emit:
+                    emitted[rid] = emitted.get(rid, 0) + 1
+        if telemetry is not None and emitted:
+            # per-burst decode timing piggybacks on the stamps this fetch took
+            # anyway (t0/done/block_ms above): ZERO new host<->device syncs —
+            # everything here reads the already-fetched host arrays
+            telemetry.decode_fetch_ms.observe(block_ms)
+            for rid, n in emitted.items():
+                telemetry.decode_tokens(rid, n, at=done)
         return events
 
     def _quarantine(self, slot: int) -> StepEvent:
@@ -1617,7 +1705,15 @@ class DecodeEngine:
             self._inflight_skip.add(slot)
         if self._faults is not None:
             self._faults.note_observed("nan_logits")
-        logger.warning("slot %d quarantined: non-finite logits", slot)
+        if self._telemetry is not None:
+            self._note_span(slot, "quarantine", reason="nan_logits")
+            self._telemetry.quarantines_total.inc()
+        rid = self._slot_rid.get(slot)
+        self._drop_rid(slot)
+        logger.warning(
+            "slot %d quarantined: non-finite logits%s",
+            slot, f" (request_id={rid})" if rid is not None else "",
+        )
         return StepEvent(slot=slot, token=-1, emit=False, finished=True, error="nan_logits")
 
     def step(self, lookahead: int = 1) -> List[StepEvent]:  # graftlint: hot-path
@@ -1774,6 +1870,8 @@ class DecodeEngine:
             self._release_prefix(slot)
         self._slot_tokens.clear()
         self._slot_queue_wait.clear()
+        self._slot_rid.clear()
+        self._slot_pending_spans.clear()
         self._remaining[:] = 0
         self._sync_slot_mirrors()
 
@@ -1802,6 +1900,8 @@ class DecodeEngine:
         self._slot_top_p[slot] = 1.0
         self._partials.pop(slot, None)
         self._slot_queue_wait.pop(slot, None)
+        if self._telemetry is not None:
+            self._drop_rid(slot)
         self._release_prefix(slot)
         self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
 
@@ -1869,6 +1969,13 @@ class DecodeEngine:
         self._slot_top_p[slot] = 1.0
         self._slot_queue_wait.pop(slot, None)
         self.preempted_requests += 1
+        if self._telemetry is not None:
+            self._note_span(
+                slot, "preempted",
+                transcript_tokens=int(valid), pinned_blocks=len(path),
+            )
+            self._telemetry.preemptions_total.inc()
+            self._drop_rid(slot)
         self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
         return PreemptedSlot(tokens=[int(t) for t in tokens], path=path)
 
@@ -2003,6 +2110,9 @@ class ContinuousBatcher:
         request.
     """
 
+    #: app-layer capability flag: generate()/stream() accept ``request_id=``
+    accepts_request_id = True
+
     def __init__(
         self,
         engine: DecodeEngine,
@@ -2010,11 +2120,26 @@ class ContinuousBatcher:
         lookahead: int = 1,
         scheduler: Optional[Any] = None,
         supervisor: Optional[Any] = None,
+        telemetry: Optional[Any] = None,
     ) -> None:
         from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
 
         self._engine = engine
         self._lookahead = max(1, int(lookahead))
+        #: span/metrics collector shared by the whole request path; the batcher
+        #: is the wiring hub — it propagates one instance into the engine, the
+        #: scheduler, the supervisor, the fault plan, and the prefix cache, so
+        #: callers only attach telemetry at ONE place (here or the engine)
+        self._telemetry = telemetry if telemetry is not None else engine._telemetry
+        if self._telemetry is not None:
+            if engine._telemetry is None:
+                engine._telemetry = self._telemetry
+            if engine._faults is not None and engine._faults.telemetry is None:
+                engine._faults.telemetry = self._telemetry
+            if engine.prefix_cache is not None and engine.prefix_cache.telemetry is None:
+                engine.prefix_cache.telemetry = self._telemetry
+            if supervisor is not None and getattr(supervisor, "_telemetry", None) is None:
+                supervisor._telemetry = self._telemetry
         #: the recovery policy layer (:class:`~unionml_tpu.serving.supervisor.
         #: EngineSupervisor`): with one attached, an engine failure salvages
         #: and RESUMES every recoverable request instead of failing the house;
@@ -2026,8 +2151,13 @@ class ContinuousBatcher:
         self.scheduler = (
             scheduler
             if isinstance(scheduler, SLOScheduler)
-            else SLOScheduler(scheduler if isinstance(scheduler, SchedulerConfig) else None)
+            else SLOScheduler(
+                scheduler if isinstance(scheduler, SchedulerConfig) else None,
+                telemetry=self._telemetry,
+            )
         )
+        if self._telemetry is not None and getattr(self.scheduler, "_telemetry", None) is None:
+            self.scheduler._telemetry = self._telemetry
         #: slot -> sink; worker-thread-only by design (admission fan-out and
         #: event dispatch both run on the worker), so no guard is declared
         self._sinks: Dict[int, Any] = {}
@@ -2052,6 +2182,27 @@ class ContinuousBatcher:
     def engine(self) -> DecodeEngine:
         return self._engine
 
+    def attach_telemetry(self, telemetry: Any) -> None:
+        """Wire a span/metrics collector into a PREBUILT batcher (no-op when
+        one is already attached): same propagation as construction-time
+        wiring, so the app layer instruments prebuilt generators uniformly.
+        Call before the first submission — the hooks are read without a lock
+        on the assumption they are set before traffic."""
+        if telemetry is None or self._telemetry is not None:
+            return
+        self._telemetry = telemetry
+        engine = self._engine
+        if engine._telemetry is None:
+            engine._telemetry = telemetry
+        if engine._faults is not None and engine._faults.telemetry is None:
+            engine._faults.telemetry = telemetry
+        if engine.prefix_cache is not None and engine.prefix_cache.telemetry is None:
+            engine.prefix_cache.telemetry = telemetry
+        if self.supervisor is not None and getattr(self.supervisor, "_telemetry", None) is None:
+            self.supervisor._telemetry = telemetry
+        if getattr(self.scheduler, "_telemetry", None) is None:
+            self.scheduler._telemetry = telemetry
+
     def _ensure_worker(self) -> None:
         if self._worker is None or not self._worker.is_alive():
             self._worker = threading.Thread(target=self._run, name="continuous-batcher", daemon=True)
@@ -2065,6 +2216,7 @@ class ContinuousBatcher:
         sampling: Optional[Dict[str, Any]] = None,
         priority: Any = None,
         deadline_ms: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> None:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         # surface bad requests on the caller's side, not the worker's
@@ -2079,16 +2231,45 @@ class ContinuousBatcher:
             prompt, int(max_new_tokens), sampling, sink,
             priority=priority, deadline_ms=deadline_ms,
         )
-        with self._lock:
-            if self._closed:
-                raise EngineFailure("batcher is closed", reason="batcher_closed")
-            # shed decisions raise HERE (caller side) while the close check
-            # still holds, so a shed request never reaches a closed queue
-            displaced = self.scheduler.submit(ticket)
+        telemetry = self._telemetry
+        if telemetry is not None:
+            from unionml_tpu.serving.scheduler import class_name
+
+            # joins the fleet-opened trace when request_id is already traced
+            # (failover keeps ONE trace across replicas); opens a fresh one
+            # for a solo batcher
+            ticket.request_id = telemetry.new_trace(
+                request_id, cls=class_name(ticket.priority)
+            )
+            telemetry.note_tokens_in(ticket.request_id, int(prompt.size))
+            telemetry.span(
+                ticket.request_id, "admission",
+                prompt_tokens=int(prompt.size), budget=int(max_new_tokens),
+                cls=class_name(ticket.priority),
+                deadline_ms=deadline_ms,
+            )
+        try:
+            with self._lock:
+                if self._closed:
+                    raise EngineFailure("batcher is closed", reason="batcher_closed")
+                # shed decisions raise HERE (caller side) while the close check
+                # still holds, so a shed request never reaches a closed queue
+                displaced = self.scheduler.submit(ticket)
+        except Exception as exc:
+            if telemetry is not None:
+                # terminal shed span + journal entry (429/503 at the route);
+                # recorded OUTSIDE both locks (telemetry is lock-leaf)
+                reason = getattr(exc, "reason", "rejected")
+                telemetry.sheds_total.inc(1.0, reason)
+                telemetry.end_trace(ticket.request_id, "shed", reason=reason)
+            raise
         if displaced is not None:
             # a full queue displaced its worst request in favor of this one:
             # fail it fast with the structured shed error (sink delivery is
             # thread-safe; displaced tickets are never resumes, so no pin)
+            if telemetry is not None:
+                telemetry.sheds_total.inc(1.0, "displaced")
+                telemetry.end_trace(displaced.request_id, "shed", reason="displaced")
             self._deliver(displaced.sink, "fail", displaced.shed_exc)
         self._ensure_worker()
         self._work.set()
@@ -2123,13 +2304,14 @@ class ContinuousBatcher:
         *,
         priority: Any = None,
         deadline_ms: Optional[float] = None,
+        request_id: Optional[str] = None,
         **sampling,
     ) -> List[int]:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         self._submit(
             prompt_ids, max_new_tokens, _FutureSink(loop, future), sampling,
-            priority=priority, deadline_ms=deadline_ms,
+            priority=priority, deadline_ms=deadline_ms, request_id=request_id,
         )
         return await future
 
@@ -2140,6 +2322,7 @@ class ContinuousBatcher:
         *,
         priority: Any = None,
         deadline_ms: Optional[float] = None,
+        request_id: Optional[str] = None,
         **sampling,
     ):
         """Async iterator of tokens, yielded as the engine decodes them.
@@ -2153,7 +2336,7 @@ class ContinuousBatcher:
         sink = _QueueSink(loop, queue)
         self._submit(
             prompt_ids, max_new_tokens, sink, sampling,
-            priority=priority, deadline_ms=deadline_ms,
+            priority=priority, deadline_ms=deadline_ms, request_id=request_id,
         )
         try:
             while True:
@@ -2189,6 +2372,15 @@ class ContinuousBatcher:
             self._engine.release_preempted(ticket.resume)
             ticket.resume = None
 
+    def _tel_end(self, ticket: Any, status: str, reason: Optional[str] = None) -> None:
+        """Close a ticket's trace on terminal delivery (no-op without telemetry
+        or for untraced tickets; always called OUTSIDE the batcher lock)."""
+        if self._telemetry is None or getattr(ticket, "request_id", None) is None:
+            return
+        if status == "shed" and reason is not None:
+            self._telemetry.sheds_total.inc(1.0, reason)
+        self._telemetry.end_trace(ticket.request_id, status, reason=reason)
+
     def _drain_orphans(self) -> None:
         """Unpin checkpoints whose tickets were dropped off-worker (close)."""
         with self._lock:
@@ -2208,6 +2400,7 @@ class ContinuousBatcher:
         now = time.monotonic()
         for ticket in self.scheduler.take_expired(now):
             self._release_ticket(ticket)
+            self._tel_end(ticket, "shed", "deadline_exceeded")
             self._deliver(
                 ticket.sink, "fail",
                 DeadlineExceededError("deadline expired while queued"),
@@ -2221,6 +2414,7 @@ class ContinuousBatcher:
                 self.scheduler.note_deadline_miss_running()
                 self._sinks.pop(slot, None)
                 self._slot_meta.pop(slot, None)
+                self._tel_end(ticket, "shed", "deadline_exceeded")
                 self._deliver(
                     ticket.sink, "fail",
                     DeadlineExceededError("deadline expired while decoding"),
@@ -2293,11 +2487,13 @@ class ContinuousBatcher:
             for ticket in batch:
                 if ticket.sink.cancelled:  # consumer gave up while queued
                     self._release_ticket(ticket)
+                    self._tel_end(ticket, "cancelled")
                     continue
                 try:
                     self._engine.validate_request(ticket.prompt, ticket.budget, **ticket.sampling)
                 except Exception as exc:  # reject this request, keep serving others
                     self._release_ticket(ticket)
+                    self._tel_end(ticket, "error", "invalid_request")
                     self._deliver(ticket.sink, "fail", exc)
                     continue
                 admissible.append(ticket)
@@ -2318,6 +2514,14 @@ class ContinuousBatcher:
         self._sinks[slot] = ticket.sink
         self._slot_meta[slot] = ticket
         self._engine.note_queue_wait(slot, ticket.queue_wait_ms)
+        if self._telemetry is not None:
+            # binds the trace to the slot AND flushes the admission-time
+            # prefill/prefix spans the engine buffered for it
+            self._engine.note_request_id(slot, ticket.request_id)
+            self._telemetry.span(
+                ticket.request_id, "admitted",
+                slot=slot, resume=ticket.resume is not None,
+            )
         if ticket.resume is not None:
             self._engine.release_preempted(ticket.resume)
             ticket.resume = None
@@ -2345,6 +2549,7 @@ class ContinuousBatcher:
             if len(admissible) == 1:
                 ticket = admissible[0]
                 self._release_ticket(ticket)
+                self._tel_end(ticket, "error", "prefill_failed")
                 self._deliver(
                     ticket.sink, "fail", _as_engine_failure(exc, reason="prefill_failed")
                 )
@@ -2361,6 +2566,7 @@ class ContinuousBatcher:
                         return False
                     self._drain_flush_events()
                     self._release_ticket(ticket)
+                    self._tel_end(ticket, "error", "prefill_failed")
                     self._deliver(
                         ticket.sink, "fail",
                         _as_engine_failure(one_exc, reason="prefill_failed"),
@@ -2378,6 +2584,8 @@ class ContinuousBatcher:
         """Fail every in-flight request (structured) and abandon the engine's
         slots — the unsupervised fallback when no recovery policy is attached."""
         failure = _as_engine_failure(exc)
+        for ticket in self._slot_meta.values():
+            self._tel_end(ticket, "error", failure.reason)
         for sink in self._sinks.values():
             self._deliver(sink, "fail", failure)
         self._sinks.clear()
@@ -2413,6 +2621,7 @@ class ContinuousBatcher:
             failure = _as_engine_failure(exc)
             for ticket in pending:
                 self._release_ticket(ticket)
+                self._tel_end(ticket, "error", failure.reason)
                 self._deliver(ticket.sink, "fail", failure)
             self._fail_all(exc)
             return
@@ -2424,12 +2633,15 @@ class ContinuousBatcher:
             pin = PreemptedSlot(tokens=list(rec.tokens), path=rec.path)
             if sink is None or meta is None or sink.cancelled:
                 engine.release_preempted(pin)  # no consumer: drop the checkpoint
+                if meta is not None:
+                    self._tel_end(meta, "cancelled")
                 continue
             try:
                 engine.validate_request(rec.tokens, max(1, int(rec.remaining)), **meta.sampling)
             except Exception as not_resumable:
                 engine.release_preempted(pin)
                 sup.note_request_failed()
+                self._tel_end(meta, "error", "request_unrecoverable")
                 self._deliver(
                     sink, "fail",
                     EngineFailure(
@@ -2449,6 +2661,13 @@ class ContinuousBatcher:
             meta.budget = int(rec.remaining)
             meta.resume = pin
             meta.sink = sink
+            if self._telemetry is not None and meta.request_id is not None:
+                # the trace stays OPEN across salvage: continuity from death to
+                # resumed decode is exactly what the failover pins assert
+                self._telemetry.span(
+                    meta.request_id, "salvaged",
+                    transcript_tokens=len(rec.tokens), remaining=int(rec.remaining),
+                )
             resumes.append(meta)
         # any sink still mapped had nothing salvageable behind it: fail it
         failure = _as_engine_failure(exc)
@@ -2456,6 +2675,7 @@ class ContinuousBatcher:
             meta = self._slot_meta.pop(slot, None)
             if meta is not None:
                 self._release_ticket(meta)
+                self._tel_end(meta, "error", failure.reason)
             sup.note_request_failed()
             self._deliver(sink, "fail", failure)
         self._sinks.clear()
@@ -2494,6 +2714,7 @@ class ContinuousBatcher:
             unavailable = sup.unavailable_error()
             for ticket in unplaced:
                 sup.note_request_failed()
+                self._tel_end(ticket, "error", getattr(unavailable, "reason", "engine_failed"))
                 self._deliver(ticket.sink, "fail", unavailable)
             return
         for meta in resumes:
@@ -2512,7 +2733,9 @@ class ContinuousBatcher:
                 continue
             if sink.cancelled:  # consumer abandoned the stream mid-decode
                 del self._sinks[event.slot]
-                self._slot_meta.pop(event.slot, None)
+                meta = self._slot_meta.pop(event.slot, None)
+                if meta is not None:
+                    self._tel_end(meta, "cancelled")
                 # a FINISHED event's slot already retired engine-side — and may
                 # even hold a newly admitted request by the time a pipeline-
                 # flushed event is delivered, so cancelling it would kill the
@@ -2528,6 +2751,7 @@ class ContinuousBatcher:
                 meta = self._slot_meta.pop(event.slot, None)
                 if meta is not None:
                     self._release_ticket(meta)
+                    self._tel_end(meta, "error", event.error)
                 if self.supervisor is not None:
                     self.supervisor.note_request_failed()
                 self._deliver(
@@ -2543,13 +2767,17 @@ class ContinuousBatcher:
                 ok = self._deliver(sink, "emit", event.token)
             if not ok:
                 del self._sinks[event.slot]
-                self._slot_meta.pop(event.slot, None)
+                meta = self._slot_meta.pop(event.slot, None)
+                if meta is not None:
+                    self._tel_end(meta, "cancelled")
                 if not event.finished:
                     self._engine.cancel(event.slot)
                 continue
             if event.finished:
                 del self._sinks[event.slot]
-                self._slot_meta.pop(event.slot, None)
+                meta = self._slot_meta.pop(event.slot, None)
+                if meta is not None:
+                    self._tel_end(meta, "ok")
                 self._deliver(sink, "finish")
 
     def _run(self) -> None:  # graftlint: hot-path
@@ -2641,6 +2869,7 @@ class ContinuousBatcher:
             if ticket.resume is not None:
                 orphans.append(ticket.resume)
                 ticket.resume = None
+            self._tel_end(ticket, "shed", "batcher_closed")
             self._deliver(ticket.sink, "fail", closed_exc)
         worker = self._worker
         if orphans:

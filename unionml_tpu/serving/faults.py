@@ -119,6 +119,9 @@ class FaultPlan:
     seed: int = 0
 
     def __post_init__(self) -> None:
+        #: optional Telemetry mirror for injected-fault counters (attach with
+        #: ``plan.telemetry = tel``); consulted behind ``is not None`` only
+        self.telemetry = None
         self._rng = np.random.default_rng(self.seed)
         self._dispatches = 0
         self._fetches = 0
@@ -142,6 +145,8 @@ class FaultPlan:
 
     def _fire(self, site: str, message: str) -> None:
         self.injected[site] = self.injected.get(site, 0) + 1
+        if self.telemetry is not None:
+            self.telemetry.faults_injected_total.inc(1.0, site)
         raise FaultError(message, site=site)
 
     def check_step_dispatch(self) -> None:
@@ -159,6 +164,8 @@ class FaultPlan:
         slots = self._nan_by_step.pop(self._dispatches, [])
         if slots:
             self.injected["nan_logits"] = self.injected.get("nan_logits", 0) + len(slots)
+            if self.telemetry is not None:
+                self.telemetry.faults_injected_total.inc(float(len(slots)), "nan_logits")
         return slots
 
     def check_fetch(self) -> None:
@@ -173,6 +180,8 @@ class FaultPlan:
         ms = self._stall_by_fetch.pop(self._fetches, None)
         if ms is not None:
             self.injected["fetch_stall"] = self.injected.get("fetch_stall", 0) + 1
+            if self.telemetry is not None:
+                self.telemetry.faults_injected_total.inc(1.0, "fetch_stall")
         return ms
 
     def check_prefill(self) -> None:
@@ -189,6 +198,8 @@ class FaultPlan:
             self._admits += 1
             if self._admits in set(self.pool_exhausted_admits):
                 self.injected["pool_exhausted"] = self.injected.get("pool_exhausted", 0) + 1
+                if self.telemetry is not None:
+                    self.telemetry.faults_injected_total.inc(1.0, "pool_exhausted")
         self._admit_depth += 1
 
     def end_admit(self) -> None:

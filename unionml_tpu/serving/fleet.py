@@ -417,6 +417,8 @@ class EngineFleet:
     """
 
     is_fleet = True
+    #: the HTTP layer may forward its request_id (trace continuity end-to-end)
+    accepts_request_id = True
 
     def __init__(
         self,
@@ -426,6 +428,7 @@ class EngineFleet:
         lookahead: int = 1,
         scheduler: Optional[SchedulerConfig] = None,
         supervisors: Optional[Sequence[Any]] = None,
+        telemetry: Optional[Any] = None,
     ) -> None:
         engines = list(engines)
         if not engines:
@@ -452,6 +455,10 @@ class EngineFleet:
         self.router = Router(
             len(engines), block_size=block_sizes.pop(), config=self.config
         )
+        #: ONE Telemetry shared fleet-wide: a trace follows its request across
+        #: replicas (failover adoption keeps the same request_id), so the
+        #: instruments must not be per-replica (``is not None`` guarded)
+        self._telemetry = telemetry
         self._replicas: List[_Replica] = []
         for index, (engine, sup) in enumerate(zip(engines, supervisors)):
             batcher = ContinuousBatcher(
@@ -459,6 +466,7 @@ class EngineFleet:
                 lookahead=lookahead,
                 scheduler=SLOScheduler(scheduler),
                 supervisor=sup,
+                telemetry=telemetry,
             )
             # failover hand-off: the dying replica's worker thread calls this
             # with its orphaned tickets; we re-route them to survivors
@@ -476,6 +484,16 @@ class EngineFleet:
         self.reroute_failed = 0  # guarded-by: _lock
 
     # ------------------------------------------------------------- structure
+
+    def attach_telemetry(self, telemetry: Any) -> None:
+        """Wire ONE span/metrics collector into a prebuilt fleet (no-op when
+        one is already attached): shared fleet-wide so traces survive
+        cross-replica failover. Call before the first routed request."""
+        if telemetry is None or self._telemetry is not None:
+            return
+        self._telemetry = telemetry
+        for rep in self._replicas:
+            rep.batcher.attach_telemetry(telemetry)
 
     @property
     def num_replicas(self) -> int:
@@ -517,14 +535,29 @@ class EngineFleet:
             out.append((rep.index, weight, load))
         return out
 
-    def _route(self, prompt_ids: Sequence[int], session_id: Optional[str]) -> _Replica:
+    def _tel_shed(self, request_id: Optional[str], reason: str) -> None:
+        """Close a request's trace on a router-level shed (before any replica
+        queue was touched); no-op without telemetry or an opened trace."""
+        if self._telemetry is None or request_id is None:
+            return
+        self._telemetry.sheds_total.inc(1.0, reason)
+        self._telemetry.end_trace(request_id, "shed", reason=reason)
+
+    def _route(
+        self,
+        prompt_ids: Sequence[int],
+        session_id: Optional[str],
+        request_id: Optional[str] = None,
+    ) -> _Replica:
         with self._lock:
             if self._closed:
+                self._tel_shed(request_id, "batcher_closed")
                 raise EngineFailure("fleet is closed", reason="batcher_closed")
         candidates = self._candidates()
         if not candidates:
             with self._lock:
                 self.shed_unavailable += 1
+            self._tel_shed(request_id, "fleet_unavailable")
             raise EngineFailure(
                 "no healthy replica in the fleet",
                 reason="fleet_unavailable",
@@ -536,14 +569,27 @@ class EngineFleet:
         if total_queued >= self.config.max_queue:
             with self._lock:
                 self.shed_queue_full += 1
+            self._tel_shed(request_id, "queue_full")
             raise QueueFullError(
                 f"fleet queue full ({total_queued} requests waiting across "
                 f"{len(self._replicas)} replicas)",
                 retry_after_s=self.config.retry_after_s,
             )
-        index, _ = self.router.route(prompt_ids, candidates, session_id=session_id)
+        index, decision = self.router.route(prompt_ids, candidates, session_id=session_id)
         with self._lock:
             self.requests_routed += 1
+        if self._telemetry is not None:
+            # router._lock was released by route(); telemetry is a leaf here
+            self._telemetry.route_decisions_total.inc(1.0, str(decision["decision"]))
+            if request_id is not None:
+                self._telemetry.span(
+                    request_id, "route",
+                    replica=index,
+                    decision=decision["decision"],
+                    matched_blocks=decision["matched_blocks"],
+                    digest_blocks=decision["digest_blocks"],
+                    candidates=len(candidates),
+                )
         return self._replicas[index]
 
     async def generate(
@@ -554,14 +600,23 @@ class EngineFleet:
         session_id: Optional[str] = None,
         priority: Any = None,
         deadline_ms: Optional[float] = None,
+        request_id: Optional[str] = None,
         **sampling,
     ) -> List[int]:
         """Route, then delegate to the chosen replica's batcher (same
         contract as ``ContinuousBatcher.generate`` + ``session_id``)."""
-        replica = self._route(prompt_ids, session_id)
+        if self._telemetry is not None:
+            # open the trace BEFORE routing so the route/shed spans land on it;
+            # the replica batcher joins it (new_trace is idempotent on an
+            # active request_id)
+            request_id = self._telemetry.new_trace(request_id)
+            replica = self._route(prompt_ids, session_id, request_id)
+        else:
+            # two-arg call kept for telemetry-less fleets (wrappable in tests)
+            replica = self._route(prompt_ids, session_id)
         return await replica.batcher.generate(
             prompt_ids, max_new_tokens, priority=priority, deadline_ms=deadline_ms,
-            **sampling,
+            request_id=request_id, **sampling,
         )
 
     async def stream(
@@ -572,15 +627,20 @@ class EngineFleet:
         session_id: Optional[str] = None,
         priority: Any = None,
         deadline_ms: Optional[float] = None,
+        request_id: Optional[str] = None,
         **sampling,
     ):
         """Route, then stream from the chosen replica (router sheds raise on
         the first ``__anext__``, before any token, like the single-engine
         path)."""
-        replica = self._route(prompt_ids, session_id)
+        if self._telemetry is not None:
+            request_id = self._telemetry.new_trace(request_id)
+            replica = self._route(prompt_ids, session_id, request_id)
+        else:
+            replica = self._route(prompt_ids, session_id)
         async for token in replica.batcher.stream(
             prompt_ids, max_new_tokens, priority=priority, deadline_ms=deadline_ms,
-            **sampling,
+            request_id=request_id, **sampling,
         ):
             yield token
 
@@ -610,6 +670,7 @@ class EngineFleet:
         for ticket in tickets:
             placed = False
             tried = {dead_index}
+            rid = getattr(ticket, "request_id", None)
             while not placed:
                 candidates = [c for c in self._candidates() if c[0] not in tried]
                 if not candidates:
@@ -621,8 +682,19 @@ class EngineFleet:
                     placed = True
                 except Exception as exc:  # closed/racing replica: try the next
                     logger.warning(
-                        "fleet failover: replica %d refused ticket (%s); trying next",
+                        "fleet failover: replica %d refused ticket (%s)%s; trying next",
                         index, exc,
+                        f" (request_id={rid})" if rid is not None else "",
+                    )
+            if placed and self._telemetry is not None:
+                self._telemetry.failover_adoptions_total.inc()
+                if rid is not None:
+                    # the trace stays OPEN: the same request_id now decodes on
+                    # the adoptive replica — continuity IS the failover pin
+                    self._telemetry.span(
+                        ticket.request_id, "failover_adopt",
+                        from_replica=dead_index, to_replica=index,
+                        transcript_tokens=len(ticket.prompt),
                     )
             with self._lock:
                 if placed:

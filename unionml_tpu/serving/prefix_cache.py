@@ -105,11 +105,13 @@ class PrefixCache:
     (:meth:`stats`) make the hit rate and eviction churn observable.
     """
 
-    def __init__(self, num_blocks: int, block_size: int) -> None:
+    def __init__(self, num_blocks: int, block_size: int, *, telemetry=None) -> None:
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        #: optional Telemetry mirror for hit-rate counters (``is not None`` guarded)
+        self.telemetry = telemetry
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self._root = _Node((), -1, None)
@@ -137,6 +139,8 @@ class PrefixCache:
         node — callers must :meth:`release` the returned path when done."""
         self._tick += 1
         self.lookups += 1
+        if self.telemetry is not None:
+            self.telemetry.prefix_lookups_total.inc()
         node, path = self._root, []  # type: ignore[var-annotated]
         while len(path) < max_blocks:
             child = node.children.get(self._key_at(tokens, len(path)))
@@ -168,6 +172,9 @@ class PrefixCache:
         if matched_tokens > 0:
             self.hits += 1
             self.hit_tokens += int(matched_tokens)
+            if self.telemetry is not None:
+                self.telemetry.prefix_hits_total.inc()
+                self.telemetry.prefix_hit_tokens_total.inc(float(matched_tokens))
 
     def extend(
         self, path: List[_Node], tokens: Sequence[int], max_blocks: int

@@ -175,6 +175,9 @@ class Ticket:
     shed_exc: Optional[SchedulingError] = None
     #: queue wait measured at pop time (ms), for TTFT decomposition
     queue_wait_ms: Optional[float] = None
+    #: trace correlation id (set by the owning batcher when telemetry is on;
+    #: rides the ticket across preemption, salvage, and fleet failover)
+    request_id: Optional[str] = None
 
     def effective_priority(self, now: float, aging_s: float) -> int:
         """Class after anti-starvation aging: one level better per ``aging_s``
@@ -196,10 +199,12 @@ class SLOScheduler:
     surfaced to the owning batcher, which performs the engine work.
     """
 
-    def __init__(self, config: Optional[SchedulerConfig] = None) -> None:
+    def __init__(self, config: Optional[SchedulerConfig] = None, *, telemetry: Optional[Any] = None) -> None:
         if config is not None and not isinstance(config, SchedulerConfig):
             raise TypeError(f"expected SchedulerConfig, got {type(config)!r}")
         self.config = config or SchedulerConfig()
+        #: optional Telemetry; every record site is OUTSIDE _lock (lock-leaf)
+        self._telemetry = telemetry
         self._lock = threading.Lock()
         self._queued: List[Ticket] = []  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
@@ -401,6 +406,15 @@ class SLOScheduler:
             self.admitted += 1
             if ticket.resume is not None:
                 self.resumes += 1
+        if self._telemetry is not None:  # outside _lock: telemetry is lock-leaf
+            self._telemetry.set_class(ticket.request_id, cls)
+            self._telemetry.queue_wait_ms.observe(wait_ms, cls)
+            self._telemetry.span(
+                ticket.request_id, "queue_wait", dur_ms=round(wait_ms, 3), cls=cls,
+                resume=ticket.resume is not None,
+            )
+            if ticket.resume is not None:
+                self._telemetry.resumes_total.inc()
 
     def peek(self, now: Optional[float] = None) -> Optional[Ticket]:
         """The ticket :meth:`pop` would return first (not removed)."""

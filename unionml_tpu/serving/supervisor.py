@@ -68,7 +68,10 @@ class EngineSupervisor:
         backoff_max_s: float = 2.0,
         time_fn: Callable[[], float] = time.monotonic,
         sleep_fn: Callable[[float], None] = time.sleep,
+        telemetry: Optional[Any] = None,
     ) -> None:
+        #: optional Telemetry; every record site runs OUTSIDE _lock (lock-leaf)
+        self._telemetry = telemetry
         self.stall_timeout_s = float(stall_timeout_s)
         self.watchdog_interval_s = float(watchdog_interval_s)
         self.max_rebuild_attempts = max(1, int(max_rebuild_attempts))
@@ -113,6 +116,8 @@ class EngineSupervisor:
         # queries this supervisor or locks a router must not deadlock
         if old == new:
             return
+        if self._telemetry is not None:
+            self._telemetry.health_transitions_total.inc(1.0, new)
         for callback in list(self._subscribers):
             try:
                 callback(old, new)
@@ -169,6 +174,8 @@ class EngineSupervisor:
                 self._state = "rebuilding"
             new = self._state
         self._notify(old, new)
+        if self._telemetry is not None:
+            self._telemetry.engine_failures_total.inc(1.0, self.classify(exc))
         logger.warning("engine failure (%s): entering recovery", self.classify(exc))
 
     def run_rebuild(self, rebuild: Callable[[], None]) -> bool:
@@ -201,6 +208,8 @@ class EngineSupervisor:
                 self._state = "ok"
                 self._note_recovery_time()
             self._notify(old, "ok")
+            if self._telemetry is not None:
+                self._telemetry.rebuilds_total.inc()
             logger.info("engine rebuilt (attempt %d/%d)", attempt, self.max_rebuild_attempts)
             return True
         with self._lock:
@@ -229,6 +238,8 @@ class EngineSupervisor:
             new = self._state
             self._note_recovery_time()
         self._notify(old, new)
+        if self._telemetry is not None:
+            self._telemetry.rebuilds_total.inc()
 
     def note_recovered(self, n: int = 1) -> None:
         """Count requests checkpoint-resumed across a rebuild."""

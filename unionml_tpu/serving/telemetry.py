@@ -1,0 +1,430 @@
+"""Per-request span tracing + replayable event journal for the serving tier.
+
+Every request admitted with telemetry enabled gets a ``request_id``-keyed
+:class:`Trace`: an ordered list of :class:`Span` records covering its whole
+lifetime — admission, queue wait (per class), the routing decision, prefix
+cache hit/restore, each prefill chunk, decode (per-burst timing piggybacked
+on the engine's existing fused deferred fetches: ZERO new host↔device
+syncs, pinned by the transfer-guard regression), preemption/resume,
+quarantine, engine death, and failover adoption. Completed traces land in a
+bounded ring journal (``/traces/recent``, ``/trace/{request_id}``) and
+optionally a JSONL sink whose schema (v1, see ``docs/observability.md``)
+is the replay input format for the ROADMAP-8 fleet simulator.
+
+Hook contract (the PR-7 FaultPlan pattern): every emitting module holds an
+``Optional[Telemetry]`` and guards each record site with a single host
+branch — ``if self._telemetry is not None`` — so disabled telemetry costs
+one pointer compare. Recording sites are LOCK-LEAF: ``Telemetry`` methods
+never call out to other serving components, and callers invoke them
+OUTSIDE their own critical sections, keeping graftlint's lock-order rule
+at 0 findings.
+
+Headline latency/throughput aggregates mirror into the shared
+:class:`~unionml_tpu.serving.metrics.MetricsRegistry` (rendered at
+``/metrics``); modules' private ``stats()`` counters are unchanged API.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+from unionml_tpu.serving.metrics import MetricsRegistry, log_buckets
+
+__all__ = [
+    "JOURNAL_SCHEMA_VERSION",
+    "Span",
+    "Telemetry",
+    "Trace",
+]
+
+#: bump when the journal JSONL schema changes shape (simulator replay input)
+JOURNAL_SCHEMA_VERSION = 1
+
+#: latency bucket bounds, ms: 0.25 ms … ~16 s in ×2 steps (17 buckets)
+_LATENCY_BUCKETS_MS = log_buckets(0.25, 2.0, 17)
+
+
+def new_request_id() -> str:
+    """A fresh 16-hex request id (also minted route-side in ``app.py``)."""
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class Span:
+    """One timed event inside a trace.
+
+    ``t_ms`` is milliseconds since the trace started (monotonic clock);
+    ``dur_ms`` is None for instantaneous markers. ``attrs`` carries
+    kind-specific detail (see the span taxonomy in
+    ``docs/observability.md``) and must stay JSON-serializable.
+    """
+
+    kind: str
+    t_ms: float
+    dur_ms: Optional[float] = None
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"kind": self.kind, "t_ms": round(self.t_ms, 3)}
+        if self.dur_ms is not None:
+            out["dur_ms"] = round(self.dur_ms, 3)
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+
+@dataclass
+class Trace:
+    """A request's full timeline; lives in ``Telemetry`` under its lock."""
+
+    request_id: str
+    created_unix: float
+    t0: float  # monotonic origin for every span's t_ms
+    cls: str = "standard"
+    status: str = "active"
+    reason: Optional[str] = None
+    tokens_in: int = 0
+    tokens_out: int = 0
+    first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
+    decode_bursts: int = 0
+    spans: List[Span] = field(default_factory=list)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return (self.first_token_t - self.t0) * 1e3
+
+    @property
+    def itl_ms(self) -> Optional[float]:
+        if self.first_token_t is None or self.last_token_t is None or self.tokens_out < 2:
+            return None
+        return (self.last_token_t - self.first_token_t) * 1e3 / (self.tokens_out - 1)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "v": JOURNAL_SCHEMA_VERSION,
+            "request_id": self.request_id,
+            "created_unix": round(self.created_unix, 6),
+            "class": self.cls,
+            "status": self.status,
+            "tokens_in": self.tokens_in,
+            "tokens_out": self.tokens_out,
+            "decode_bursts": self.decode_bursts,
+            "spans": [s.to_dict() for s in self.spans],
+        }
+        if self.reason is not None:
+            out["reason"] = self.reason
+        ttft = self.ttft_ms
+        if ttft is not None:
+            out["ttft_ms"] = round(ttft, 3)
+        itl = self.itl_ms
+        if itl is not None:
+            out["itl_ms"] = round(itl, 3)
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+
+class Telemetry:
+    """Process-wide trace collector + metrics mirror for one serving stack.
+
+    One instance is shared by the whole request path (app → fleet → router
+    → batcher → engine → scheduler/supervisor/prefix-cache/faults), so a
+    request keeps ONE trace across replica failover. All methods are
+    thread-safe behind a single leaf lock and never raise on unknown
+    request ids (a span for a request that was never traced, or already
+    journaled, is dropped) — recording must never take down serving.
+
+    :param registry: shared :class:`MetricsRegistry`; a fresh one is
+        created when omitted.
+    :param journal_size: completed traces kept in the in-memory ring
+        (``/traces/recent``).
+    :param journal_path: optional JSONL file appended one completed trace
+        per line — the ROADMAP-8 simulator's replay input.
+    :param max_spans: per-trace span cap; beyond it spans are dropped and
+        counted in ``attrs["spans_dropped"]`` (bounds runaway requests).
+    """
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        *,
+        journal_size: int = 256,
+        journal_path: Optional[str] = None,
+        max_spans: int = 512,
+    ) -> None:
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        self._max_spans = int(max_spans)
+        #: guards _active/_ring/_completed; LEAF (never calls out — see module doc)
+        self._lock = threading.Lock()
+        self._active: Dict[str, Trace] = {}  # guarded-by: _lock
+        self._ring: Deque[Trace] = deque(maxlen=int(journal_size))  # guarded-by: _lock
+        self._completed = 0  # guarded-by: _lock
+        self._dropped_spans = 0  # guarded-by: _lock
+        self.journal_path = journal_path
+        #: serializes JSONL appends only; LEAF, never held with _lock
+        self._journal_lock = threading.Lock()
+
+        m = self.metrics
+        self.requests_total = m.counter(
+            "unionml_requests_total", "Completed requests by outcome", ("outcome",)
+        )
+        self.sheds_total = m.counter(
+            "unionml_sheds_total", "Requests shed by structured reason", ("reason",)
+        )
+        self.tokens_in_total = m.counter("unionml_tokens_in_total", "Prompt tokens accepted")
+        self.tokens_out_total = m.counter("unionml_tokens_out_total", "Tokens decoded and delivered")
+        self.prefill_tokens_total = m.counter(
+            "unionml_prefill_tokens_total", "Tokens run through prefill (incl. restored-suffix recompute)"
+        )
+        self.ttft_ms = m.histogram(
+            "unionml_ttft_ms", "Time to first token, ms", _LATENCY_BUCKETS_MS, ("cls",)
+        )
+        self.itl_ms = m.histogram(
+            "unionml_itl_ms", "Mean inter-token latency per request, ms", _LATENCY_BUCKETS_MS, ("cls",)
+        )
+        self.queue_wait_ms = m.histogram(
+            "unionml_queue_wait_ms", "Scheduler queue wait, ms", _LATENCY_BUCKETS_MS, ("cls",)
+        )
+        self.decode_fetch_ms = m.histogram(
+            "unionml_decode_fetch_ms",
+            "Host-blocked time per fused decode-burst fetch, ms",
+            _LATENCY_BUCKETS_MS,
+        )
+        self.route_decisions_total = m.counter(
+            "unionml_route_decisions_total", "Fleet routing decisions by type", ("decision",)
+        )
+        self.prefix_lookups_total = m.counter(
+            "unionml_prefix_lookups_total", "Prefix-cache lookups"
+        )
+        self.prefix_hits_total = m.counter(
+            "unionml_prefix_hits_total", "Prefix-cache lookups that matched at least one block"
+        )
+        self.prefix_hit_tokens_total = m.counter(
+            "unionml_prefix_hit_tokens_total", "Prompt tokens served from the prefix cache"
+        )
+        self.preemptions_total = m.counter(
+            "unionml_preemptions_total", "Requests preempted to the prefix cache"
+        )
+        self.resumes_total = m.counter(
+            "unionml_resumes_total", "Preempted/salvaged requests re-admitted"
+        )
+        self.quarantines_total = m.counter(
+            "unionml_quarantines_total", "Slots quarantined (NaN logits)"
+        )
+        self.engine_failures_total = m.counter(
+            "unionml_engine_failures_total", "Engine-wide failures by classified reason", ("reason",)
+        )
+        self.rebuilds_total = m.counter(
+            "unionml_rebuilds_total", "Successful in-place engine rebuilds"
+        )
+        self.health_transitions_total = m.counter(
+            "unionml_health_transitions_total", "Supervisor health-state transitions", ("to",)
+        )
+        self.failover_adoptions_total = m.counter(
+            "unionml_failover_adoptions_total", "Orphaned tickets adopted by a surviving replica"
+        )
+        self.faults_injected_total = m.counter(
+            "unionml_faults_injected_total", "Faults injected by the active FaultPlan", ("site",)
+        )
+
+    # ------------------------------------------------------------------ traces
+
+    def new_trace(
+        self, request_id: Optional[str] = None, *, cls: str = "standard", **attrs: Any
+    ) -> str:
+        """Open (or join) the trace for ``request_id``; returns the id.
+
+        Idempotent on an already-active id — the fleet opens the trace
+        before routing and the replica batcher joins it, so failover
+        keeps one trace across engines. Re-opening refreshes nothing but
+        merges ``attrs``.
+        """
+        rid = request_id if request_id else new_request_id()
+        with self._lock:
+            trace = self._active.get(rid)
+            if trace is None:
+                trace = Trace(
+                    request_id=rid,
+                    created_unix=time.time(),
+                    t0=time.perf_counter(),
+                    cls=cls,
+                )
+                self._active[rid] = trace
+            if attrs:
+                trace.attrs.update(attrs)
+            if cls != "standard":
+                trace.cls = cls
+        return rid
+
+    def set_class(self, request_id: Optional[str], cls: str) -> None:
+        if request_id is None:
+            return
+        with self._lock:
+            trace = self._active.get(request_id)
+            if trace is not None:
+                trace.cls = cls
+
+    def span(
+        self,
+        request_id: Optional[str],
+        kind: str,
+        *,
+        dur_ms: Optional[float] = None,
+        at: Optional[float] = None,
+        **attrs: Any,
+    ) -> None:
+        """Append a span to an active trace (no-op for unknown/ended ids).
+
+        ``at`` is an optional ``time.perf_counter()`` stamp for spans whose
+        event happened earlier than the record call (the engine buffers
+        slot-keyed spans until the batcher binds the slot's request id)."""
+        if request_id is None:
+            return
+        now = time.perf_counter() if at is None else at
+        with self._lock:
+            trace = self._active.get(request_id)
+            if trace is None:
+                return
+            if len(trace.spans) >= self._max_spans:
+                self._dropped_spans += 1
+                trace.attrs["spans_dropped"] = trace.attrs.get("spans_dropped", 0) + 1
+                return
+            trace.spans.append(Span(kind, (now - trace.t0) * 1e3, dur_ms, dict(attrs)))
+
+    def note_tokens_in(self, request_id: Optional[str], n: int) -> None:
+        self.tokens_in_total.inc(n)
+        if request_id is None:
+            return
+        with self._lock:
+            trace = self._active.get(request_id)
+            if trace is not None:
+                trace.tokens_in = int(n)
+
+    def decode_tokens(
+        self,
+        request_id: Optional[str],
+        n: int,
+        *,
+        at: Optional[float] = None,
+        block_ms: Optional[float] = None,
+    ) -> None:
+        """Record ``n`` tokens surfacing from one fused decode-burst fetch.
+
+        ``at`` is the fetch's existing ``time.perf_counter()`` completion
+        stamp and ``block_ms`` its already-measured host-blocked time —
+        both piggyback on measurements the engine takes anyway, so the
+        decode path pays no new host↔device syncs for tracing.
+        """
+        self.tokens_out_total.inc(n)
+        if block_ms is not None:
+            self.decode_fetch_ms.observe(block_ms)
+        if request_id is None:
+            return
+        t = at if at is not None else time.perf_counter()
+        first: Optional[Trace] = None
+        with self._lock:
+            trace = self._active.get(request_id)
+            if trace is None:
+                return
+            trace.tokens_out += int(n)
+            trace.decode_bursts += 1
+            trace.last_token_t = t
+            if trace.first_token_t is None:
+                trace.first_token_t = t
+                first = trace
+        if first is not None:
+            ttft = first.ttft_ms
+            if ttft is not None:
+                self.ttft_ms.observe(ttft, first.cls)
+
+    def end_trace(
+        self,
+        request_id: Optional[str],
+        status: str = "ok",
+        *,
+        reason: Optional[str] = None,
+        **attrs: Any,
+    ) -> None:
+        """Complete a trace: journal it and observe its latency aggregates.
+
+        A trace survives preemption, quarantine-of-siblings, engine death,
+        and failover — only terminal delivery (tokens, structured error,
+        or shed) ends it. Ending an unknown id is a no-op.
+        """
+        if request_id is None:
+            return
+        now = time.perf_counter()
+        with self._lock:
+            trace = self._active.pop(request_id, None)
+            if trace is None:
+                return
+            trace.status = status
+            trace.reason = reason
+            if attrs:
+                trace.attrs.update(attrs)
+            dur = (now - trace.t0) * 1e3
+            if trace.tokens_out > 0 and trace.first_token_t is not None:
+                # one aggregated decode span per request (per-burst detail
+                # would be unbounded); timing reuses the fused-fetch stamps
+                last = trace.last_token_t if trace.last_token_t is not None else trace.first_token_t
+                trace.spans.append(
+                    Span(
+                        "decode",
+                        (trace.first_token_t - trace.t0) * 1e3,
+                        (last - trace.first_token_t) * 1e3,
+                        {"tokens": trace.tokens_out, "bursts": trace.decode_bursts},
+                    )
+                )
+            trace.spans.append(Span("end", dur, None, {"status": status} if reason is None else {"status": status, "reason": reason}))
+            self._ring.append(trace)
+            self._completed += 1
+        self.requests_total.inc(1.0, status)
+        itl = trace.itl_ms
+        if itl is not None:
+            self.itl_ms.observe(itl, trace.cls)
+        if self.journal_path is not None:
+            line = json.dumps(trace.to_dict(), separators=(",", ":"))
+            try:
+                with self._journal_lock, open(self.journal_path, "a") as fh:
+                    fh.write(line + "\n")
+            except OSError:  # journal loss must never take down serving
+                pass
+
+    # ---------------------------------------------------------------- readers
+
+    def get_trace(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """The span tree for one request — active traces included."""
+        with self._lock:
+            trace = self._active.get(request_id)
+            if trace is None:
+                for t in self._ring:
+                    if t.request_id == request_id:
+                        trace = t
+                        break
+            return trace.to_dict() if trace is not None else None
+
+    def recent(self, n: int = 50) -> List[Dict[str, Any]]:
+        """The most recently completed traces, newest last."""
+        with self._lock:
+            items = list(self._ring)[-int(n):]
+            return [t.to_dict() for t in items]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active_traces": len(self._active),
+                "completed_traces": self._completed,
+                "journal_depth": len(self._ring),
+                "journal_path": self.journal_path,
+                "spans_dropped": self._dropped_spans,
+            }
