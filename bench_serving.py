@@ -77,7 +77,7 @@ class _RetraceCounter:
             return
         try:
             self._monitoring._unregister_event_duration_listener_by_callback(self._listener)
-        except Exception:  # graftlint: disable=swallowed-exception -- jax.monitoring listener API drift: a leaked counter only overcounts retraces
+        except Exception:  # listener API drift: a leaked counter only overcounts retraces
             pass
 
 

@@ -1,0 +1,370 @@
+"""graftlint v3 CFG builder: exception edges, finally duplication, loops.
+
+Tier-1 gate for the control-flow graph the resource-lifetime rules stand on
+(``unionml_tpu/analysis/cfg.py``). The contract pinned here:
+
+- every content block carries exactly ONE ``except`` edge, explicit only when
+  the statement is a ``raise``;
+- ``try`` dispatch blocks fan out to each handler and propagate outward only
+  when no handler is broad;
+- ``finally`` bodies are duplicated per continuation (return vs. exception
+  vs. fall-through) and memoized per (try, continuation) pair;
+- loops carry ``back`` edges, so a loop-carried re-acquire is reachability;
+- ``with`` headers are modeled without ``__exit__`` edges;
+- ``regions`` records the lexically enclosing handlers.
+
+Pure-AST: no jax, no model, no tmp files.
+"""
+
+import ast
+import textwrap
+
+from unionml_tpu.analysis.cfg import ALWAYS_KINDS, build_cfg, path_to, reachable
+
+
+def _cfg(src: str):
+    tree = ast.parse(textwrap.dedent(src))
+    return build_cfg(tree.body[0])
+
+
+def _blocks_of(cfg, kind):
+    return [b for b in cfg.blocks.values() if b.kind == kind]
+
+
+def _stmt_block(cfg, needle: str):
+    """Content blocks whose simple-statement source contains ``needle``
+    (compound headers hold whole subtrees, so only ``stmt`` items count)."""
+    hits = []
+    for b in cfg.blocks.values():
+        for node, role in b.items:
+            if role == "stmt" and needle in ast.unparse(node):
+                hits.append(b)
+                break
+    assert hits, f"no block contains {needle!r}"
+    return hits
+
+
+def _flow_chain(cfg, start: int):
+    """Statement texts along the unique non-except path from ``start``,
+    plus the block id the chain ends on (exit/rexit)."""
+    texts, bid = [], start
+    for _ in range(len(cfg.blocks)):
+        b = cfg.blocks[bid]
+        texts += [ast.unparse(n) for n, r in b.items if r == "stmt"]
+        nxt = [e for e in b.edges if e.kind != "except"]
+        if not nxt:
+            break
+        assert len(nxt) == 1, f"chain forks at block {bid}"
+        bid = nxt[0].dst
+        if bid in (cfg.exit, cfg.rexit):
+            break
+    return texts, bid
+
+
+def _except_edges(block):
+    return [e for e in block.edges if e.kind == "except"]
+
+
+# ------------------------------------------------------------- basic shape
+
+
+def test_linear_function_every_block_has_one_except_edge():
+    cfg = _cfg(
+        """
+        def f(x):
+            a = x + 1
+            b = a * 2
+            return b
+        """
+    )
+    content = [
+        b for b in cfg.blocks.values() if b.kind not in ("entry", "exit", "rexit")
+    ]
+    assert len(content) == 3
+    for b in content:
+        edges = _except_edges(b)
+        assert len(edges) == 1, f"block L{b.line} has {len(edges)} except edges"
+        assert not edges[0].explicit  # no raise statements here
+        assert edges[0].dst == cfg.rexit  # no enclosing try: straight out
+    (ret,) = _stmt_block(cfg, "return b")
+    assert any(e.kind == "return" and e.dst == cfg.exit for e in ret.edges)
+
+
+def test_raise_gets_explicit_edge_and_no_fallthrough():
+    cfg = _cfg(
+        """
+        def f():
+            raise ValueError("boom")
+        """
+    )
+    (blk,) = _stmt_block(cfg, "raise ValueError")
+    assert len(blk.edges) == 1  # the except edge is the ONLY successor
+    (e,) = blk.edges
+    assert e.kind == "except" and e.explicit and e.dst == cfg.rexit
+
+
+def test_assert_stays_implicit():
+    # deliberate: assert raising is modeled as MAY, so test files stay quiet
+    cfg = _cfg(
+        """
+        def f(x):
+            assert x > 0
+            return x
+        """
+    )
+    (blk,) = _stmt_block(cfg, "assert x > 0")
+    (e,) = _except_edges(blk)
+    assert not e.explicit
+
+
+def test_if_branches_rejoin():
+    cfg = _cfg(
+        """
+        def f(x):
+            if x:
+                y = 1
+            else:
+                y = 2
+            return y
+        """
+    )
+    (branch,) = _blocks_of(cfg, "branch")
+    kinds = sorted(e.kind for e in branch.edges)
+    assert kinds == ["except", "false", "true"]
+    # both arms flow into the single return block
+    (ret,) = _stmt_block(cfg, "return y")
+    preds = {src for src, _e in cfg.preds()[ret.id]}
+    assert len(preds) == 2
+
+
+# ------------------------------------------------------------------- loops
+
+
+def test_loop_back_edge_and_loop_carried_reachability():
+    cfg = _cfg(
+        """
+        def f(items):
+            for it in items:
+                h = acquire(it)
+                use(h)
+            return None
+        """
+    )
+    (acq,) = _stmt_block(cfg, "acquire(it)")
+    # the body's last statement carries a back edge to the loop header
+    (use,) = _stmt_block(cfg, "use(h)")
+    assert any(e.kind == "back" for e in use.edges)
+    # loop-carried: following only sure edges, the acquire reaches ITSELF
+    parents = reachable(cfg, acq.id, follow=lambda _b, e: e.kind in ALWAYS_KINDS)
+    hits_self = any(
+        e.dst == acq.id
+        for bid in parents
+        for e in cfg.blocks[bid].edges
+        if e.kind in ALWAYS_KINDS
+    )
+    assert hits_self
+
+
+def test_break_skips_orelse_continue_returns_to_header():
+    cfg = _cfg(
+        """
+        def f(items):
+            while items:
+                if items[0]:
+                    break
+                continue
+            else:
+                tail()
+            return None
+        """
+    )
+    (brk,) = _stmt_block(cfg, "break")
+    (cont,) = _stmt_block(cfg, "continue")
+    (tail,) = _stmt_block(cfg, "tail()")
+    header = next(b for b in _blocks_of(cfg, "branch") if b.items[0][1] == "test")
+    join = _blocks_of(cfg, "join")[0]
+    assert any(e.dst == join.id for e in brk.edges if e.kind == "flow")
+    assert any(e.dst == header.id for e in cont.edges if e.kind == "flow")
+    # the else: arm hangs off the header's false edge, not off break
+    assert any(e.kind == "false" and e.dst == tail.id for e in header.edges)
+
+
+# -------------------------------------------------------------- try/except
+
+
+def test_dispatch_fans_out_and_propagates_past_narrow_handlers():
+    cfg = _cfg(
+        """
+        def f():
+            try:
+                work()
+            except ValueError:
+                a()
+            except KeyError:
+                b()
+        """
+    )
+    (dispatch,) = _blocks_of(cfg, "dispatch")
+    handler_edges = [e for e in dispatch.edges if e.kind == "handler"]
+    assert len(handler_edges) == 2
+    # narrow handlers: the unmatched exception still propagates outward
+    props = [e for e in dispatch.edges if e.kind == "propagate"]
+    assert len(props) == 1 and props[0].dst == cfg.rexit
+    # the try body's except edge targets the dispatch, not rexit
+    (work,) = _stmt_block(cfg, "work()")
+    (exc,) = _except_edges(work)
+    assert exc.dst == dispatch.id
+
+
+def test_broad_handler_terminates_propagation():
+    cfg = _cfg(
+        """
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+        """
+    )
+    (dispatch,) = _blocks_of(cfg, "dispatch")
+    assert not any(e.kind == "propagate" for e in dispatch.edges)
+
+
+def test_handler_region_marks_enclosed_blocks():
+    cfg = _cfg(
+        """
+        def f():
+            try:
+                work()
+            except Exception as exc:
+                log(exc)
+            after()
+        """
+    )
+    tree_handler = None
+    for b in _blocks_of(cfg, "handler"):
+        tree_handler = b.items[0][0]
+    (log_blk,) = _stmt_block(cfg, "log(exc)")
+    (after_blk,) = _stmt_block(cfg, "after()")
+    assert tree_handler in log_blk.regions
+    assert tree_handler not in after_blk.regions
+
+
+def test_raise_in_else_bypasses_own_handlers():
+    cfg = _cfg(
+        """
+        def f():
+            try:
+                work()
+            except ValueError:
+                pass
+            else:
+                raise RuntimeError("late")
+        """
+    )
+    (late,) = _stmt_block(cfg, 'raise RuntimeError')
+    (e,) = late.edges
+    assert e.kind == "except" and e.explicit
+    assert e.dst == cfg.rexit  # NOT this try's dispatch
+
+
+# ----------------------------------------------------------------- finally
+
+
+def test_finally_duplicated_per_continuation_and_memoized():
+    cfg = _cfg(
+        """
+        def f(x):
+            try:
+                if x:
+                    return early()
+                work()
+            finally:
+                cleanup()
+        """
+    )
+    copies = _stmt_block(cfg, "cleanup()")
+    # one copy for the return path, one for the exception path, one inline
+    # for normal completion
+    assert len(copies) == 3
+    # the return statement routes through a finally copy, then exit
+    (ret,) = _stmt_block(cfg, "return early()")
+    ret_edge = next(e for e in ret.edges if e.kind == "return")
+    fin = cfg.blocks[ret_edge.dst]
+    assert fin.kind == "finally"
+    texts, end = _flow_chain(cfg, fin.id)
+    assert texts == ["cleanup()"] and end == cfg.exit
+    # the exception copy continues to rexit
+    (work,) = _stmt_block(cfg, "work()")
+    (exc,) = _except_edges(work)
+    fin2 = cfg.blocks[exc.dst]
+    assert fin2.kind == "finally" and fin2.id != fin.id
+    texts2, end2 = _flow_chain(cfg, fin2.id)
+    assert texts2 == ["cleanup()"] and end2 == cfg.rexit
+    # memoized: a second raise-capable block shares the same exception copy
+    (test_blk,) = [b for b in _blocks_of(cfg, "branch")]
+    (exc2,) = _except_edges(test_blk)
+    assert exc2.dst == fin2.id
+
+
+def test_nested_finally_chains_innermost_first():
+    cfg = _cfg(
+        """
+        def f():
+            try:
+                try:
+                    return val()
+                finally:
+                    inner()
+            finally:
+                outer()
+        """
+    )
+    (ret,) = _stmt_block(cfg, "return val()")
+    ret_edge = next(e for e in ret.edges if e.kind == "return")
+    texts, end = _flow_chain(cfg, ret_edge.dst)
+    assert texts == ["inner()", "outer()"]  # interpreter order
+    assert end == cfg.exit
+
+
+# ------------------------------------------------------------ with / paths
+
+
+def test_with_header_has_no_exit_edges():
+    cfg = _cfg(
+        """
+        def f(p):
+            with open(p) as fh:
+                fh.read()
+        """
+    )
+    (hdr,) = [b for b in cfg.blocks.values() if b.items and b.items[0][1] == "with"]
+    kinds = sorted(e.kind for e in hdr.edges)
+    assert kinds == ["except", "flow"]  # no synthetic __exit__ edge
+
+
+def test_reachable_stop_and_path_to():
+    cfg = _cfg(
+        """
+        def f():
+            a()
+            release()
+            b()
+        """
+    )
+    (start,) = _stmt_block(cfg, "a()")
+    (rel,) = _stmt_block(cfg, "release()")
+    (after,) = _stmt_block(cfg, "b()")
+
+    def releases(block):
+        return any("release" in ast.unparse(n) for n, _r in block.items)
+
+    parents = reachable(
+        cfg,
+        start.id,
+        follow=lambda _b, e: e.kind in ALWAYS_KINDS,
+        stop=releases,
+    )
+    assert rel.id in parents  # visited...
+    assert after.id not in parents  # ...but not expanded past
+    assert path_to(parents, rel.id) == [start.id, rel.id]

@@ -32,12 +32,31 @@ from unionml_tpu.serving.continuous import ContinuousBatcher, DecodeEngine
 from unionml_tpu.serving.faults import EngineFailure, FaultError, FaultPlan
 from unionml_tpu.serving.scheduler import DeadlineExceededError
 from unionml_tpu.serving.supervisor import EngineSupervisor
+from unionml_tpu.serving.telemetry import Telemetry
 
 
 @pytest.fixture(scope="module")
 def gpt(gpt_tiny_session):
     _, model, variables = gpt_tiny_session
     return model, variables
+
+
+@pytest.fixture(autouse=True)
+def _balanced_traces(monkeypatch):
+    """Chaos runs must not leave half-terminated traces behind: any Telemetry
+    created during a scenario gets ``assert_balanced`` at teardown (the
+    dynamic counterpart of the static ``trace`` resource-lifetime rule)."""
+    created = []
+    orig_init = Telemetry.__init__
+
+    def _recording_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        created.append(self)
+
+    monkeypatch.setattr(Telemetry, "__init__", _recording_init)
+    yield
+    for tel in created:
+        tel.assert_balanced(allow_active=True)
 
 
 def _mesh4():

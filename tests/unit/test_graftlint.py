@@ -821,3 +821,269 @@ def test_mutated_engine_rebind_is_caught():
         f.rule == "use-after-donate" and "state['cache']" in f.message
         for f in result.findings
     ), [f.format() for f in result.findings]
+
+
+# ----------------------------------------- resource lifetime (graftlint v3)
+# (cfg + rules_resources: leak-on-exception-path, double-release,
+# unbalanced-transfer, and the owns/transfers/holds contract comments)
+
+RESOURCE_REPRO = '''
+class Batcher:
+    def __init__(self, prefix_cache, telemetry):
+        self.prefix_cache = prefix_cache
+        self.telemetry = telemetry
+
+    def leak_on_raise(self, path, slot):
+        self.prefix_cache.pin(path)
+        self.bookkeep(slot)
+        return path
+
+    def bookkeep(self, slot):
+        raise RuntimeError(slot)
+
+    def span_leak(self, rid, payload):
+        trace = self.telemetry.new_trace(rid)
+        if payload is None:
+            return None
+        self.telemetry.end_trace(trace)
+        return trace
+
+    def double(self, path):
+        self.prefix_cache.release(path)
+        self.prefix_cache.release(path)
+
+    # transfers: kv-pin
+    def bad_transfer(self, path):
+        self.prefix_cache.pin(path)
+        self.prefix_cache.unpin(path)
+        return path
+
+    # owns: kv-pin
+    def broken_owner(self, path):
+        self.log(path)
+
+    def log(self, path):
+        pass
+'''
+
+RESOURCE_CLEAN = '''
+class Batcher:
+    def __init__(self, prefix_cache, telemetry):
+        self.prefix_cache = prefix_cache
+        self.telemetry = telemetry
+
+    def fixed(self, path, slot):
+        self.prefix_cache.pin(path)
+        try:
+            self.bookkeep(slot)
+        except Exception:
+            self.prefix_cache.unpin(path)
+            raise
+        return path
+
+    def bookkeep(self, slot):
+        raise RuntimeError(slot)
+
+    def span_balanced(self, rid):
+        trace = self.telemetry.new_trace(rid)
+        try:
+            self.bookkeep(rid)
+        finally:
+            self.telemetry.end_trace(trace)
+
+    def double_ok(self, path, tokens):
+        self.prefix_cache.release(path)
+        path, extra = self.prefix_cache.match(tokens)
+        self.prefix_cache.release(path)
+        return extra
+
+    # transfers: kv-pin
+    def hands_over(self, path):
+        self.prefix_cache.pin(path)
+        return path
+
+    # owns: kv-pin
+    def good_owner(self, path):
+        self.prefix_cache.unpin(path)
+
+    def escapes_to_state(self, registry, path):
+        self.prefix_cache.pin(path)
+        registry[path] = 1
+        self.bookkeep(path)
+
+    def with_is_not_an_acquire(self, p):
+        with open(p) as fh:
+            return fh.read()
+'''
+
+
+def test_resource_repro_fires_all_three_shapes(tmp_path):
+    result = _lint_source(tmp_path, "rsrc", RESOURCE_REPRO)
+    assert {f.rule for f in result.findings} == {
+        "resource-leak", "double-release", "unbalanced-transfer",
+    }
+    by_symbol = {f.symbol: f for f in result.findings}
+    # exception-path leak names the noun and carries a line witness
+    leak = by_symbol["Batcher.leak_on_raise"]
+    assert "exception path" in leak.message and "->" in leak.message
+    # normal-exit trace leak (the early return skips end_trace)
+    span = by_symbol["Batcher.span_leak"]
+    assert "end_trace" in span.message
+    # double-release points at the second release and the first's line
+    dbl = by_symbol["Batcher.double"]
+    assert dbl.rule == "double-release" and "already released" in dbl.message
+    # a transfers-annotated function that ALSO releases is flagged there
+    xfer = by_symbol["Batcher.bad_transfer"]
+    assert xfer.rule == "unbalanced-transfer"
+    # an owns-annotated function that never releases breaks the contract
+    assert "owns: kv-pin" in by_symbol["Batcher.broken_owner"].message
+
+
+def test_resource_clean_twin_is_finding_free(tmp_path):
+    """Each repro shape's fixed form: release-on-error handler, finally-based
+    trace balance, re-acquire between releases, honored transfer/owns
+    contracts, escape-into-state, and ``with`` (context managers release
+    their own resource)."""
+    result = _lint_source(tmp_path, "rsrc_ok", RESOURCE_CLEAN)
+    assert result.ok, [f.format() for f in result.findings]
+
+
+def test_resource_golden_report(tmp_path):
+    """Machine-readable pin for the resource family: rule ids, lines, columns,
+    symbols — the exact shape CI tooling consumes."""
+    expected = [
+        {"rule": "resource-leak", "line": 8, "col": 8, "symbol": "Batcher.leak_on_raise"},
+        {"rule": "resource-leak", "line": 16, "col": 16, "symbol": "Batcher.span_leak"},
+        {"rule": "double-release", "line": 24, "col": 8, "symbol": "Batcher.double"},
+        {"rule": "unbalanced-transfer", "line": 29, "col": 8, "symbol": "Batcher.bad_transfer"},
+        {"rule": "resource-leak", "line": 33, "col": 4, "symbol": "Batcher.broken_owner"},
+    ]
+    report = _lint_source(tmp_path, "rsrc", RESOURCE_REPRO).report()
+    got = [
+        {k: entry[k] for k in ("rule", "line", "col", "symbol")}
+        for entry in report["findings"]
+    ]
+    assert got == expected, json.dumps(got, indent=2)
+    assert report["counts"]["findings"] == len(expected)
+
+
+def test_resource_rules_are_registered_and_listable(capsys):
+    from unionml_tpu.analysis.core import RULES, _load_rule_modules
+
+    _load_rule_modules()
+    assert {"resource-leak", "double-release", "unbalanced-transfer"} <= set(RULES)
+    assert lint_main(["--list-rules"]) == 0
+    listing = capsys.readouterr().out
+    for name in ("resource-leak", "double-release", "unbalanced-transfer"):
+        assert name in listing
+
+
+def test_resource_sarif_validates_and_catalogs_the_family(tmp_path):
+    import pathlib
+
+    jsonschema = pytest.importorskip("jsonschema")
+    schema = json.loads(
+        (pathlib.Path(__file__).parent / "sarif_2_1_0_schema.json").read_text()
+    )
+    doc = _lint_source(tmp_path, "rsrc", RESOURCE_REPRO).sarif()
+    jsonschema.validate(doc, schema)
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"resource-leak", "double-release", "unbalanced-transfer"} <= rules
+    hit = {r["ruleId"] for r in doc["runs"][0]["results"]}
+    assert hit == {"resource-leak", "double-release", "unbalanced-transfer"}
+
+
+SWALLOWED_CLEAN_V3 = '''
+def best_effort_teardown(sub, fh):
+    try:
+        sub.unsubscribe()
+        fh.close()
+    except Exception:
+        pass
+
+def fallback_value(probe):
+    try:
+        raw = probe()
+    except Exception:
+        raw = {}
+    return raw
+
+def release_on_error(cache, path, slot):
+    cache.pin(path)
+    try:
+        note(slot)
+    except Exception:
+        cache.unpin(path)
+        failed = True
+    return path
+'''
+
+
+def test_swallowed_exception_v3_exempts_handling_by_construction(tmp_path):
+    """The three CFG-aware exemptions: best-effort release teardown, fallback
+    binding, and a release-on-error handler whose every exit path releases —
+    none needs a suppression anymore (the resource family also stays quiet:
+    the handler IS the release path it demands)."""
+    result = _lint_source(tmp_path, "sw3", SWALLOWED_CLEAN_V3)
+    assert result.ok, [f.format() for f in result.findings]
+
+
+@pytest.mark.parametrize(
+    "label, old, new, symbol, witness",
+    [
+        (
+            "unpin-in-discard_salvage",
+            "                self.prefix_cache.unpin(rec.path)\n",
+            "                pass\n",
+            "DecodeEngine.discard_salvage",
+            "relied on by",
+        ),
+        (
+            "unpin-in-release_preempted",
+            "            self.prefix_cache.unpin(state.path)\n",
+            "            pass\n",
+            "DecodeEngine.release_preempted",
+            "ContinuousBatcher._maybe_preempt",
+        ),
+        (
+            "end_trace-in-_tel_end",
+            "        self._telemetry.end_trace(ticket.request_id, status, reason=reason)\n",
+            "        pass\n",
+            "ContinuousBatcher._tel_end",
+            "owns: trace",
+        ),
+        (
+            "discard-in-_capture_salvage",
+            "        self.discard_salvage()  # a prior incident's uncollected records\n",
+            "",
+            "DecodeEngine._capture_salvage",
+            "holds: kv-pin",
+        ),
+    ],
+)
+def test_mutated_serving_release_path_is_caught(label, old, new, symbol, witness):
+    """Tree-grounded regressions, one per resource class: delete a single
+    release from the REAL serving source and the resource family must
+    produce EXACTLY ONE finding naming the broken function — the leak
+    contracts are mechanically enforced, not reviewer folklore."""
+    import pathlib
+    import tempfile
+
+    from unionml_tpu.analysis import run_lint as _run
+
+    src = (
+        pathlib.Path(__file__).resolve().parent.parent.parent
+        / "unionml_tpu" / "serving" / "continuous.py"
+    ).read_text()
+    mutated = src.replace(old, new, 1)
+    assert mutated != src, f"{label}: the release moved; update this mutation"
+    with tempfile.TemporaryDirectory() as d:
+        f = pathlib.Path(d) / "continuous.py"
+        f.write_text(mutated)
+        result = _run(
+            [str(f)], ["resource-leak", "double-release", "unbalanced-transfer"]
+        )
+    assert len(result.findings) == 1, [x.format() for x in result.findings]
+    (finding,) = result.findings
+    assert finding.symbol == symbol
+    assert witness in finding.message

@@ -4,7 +4,8 @@ This is the whole point of the linter (ISSUE 4, widened by ISSUE 6): the
 invariants PRs 1–5 each re-derived by hand — no host syncs on the decode hot
 path, no retrace churn, sharding specs that name real mesh axes, guarded host
 state written under its lock, donated buffers rebound before reuse, no lock
-cycles, no event-loop stalls — are checked mechanically over the package PLUS
+cycles, no event-loop stalls, and (v3) no leaked pins/refs/traces/slots/
+tickets/handles on any path — are checked mechanically over the package PLUS
 ``bench_*.py`` and ``tools/`` on every run. ``tests/`` rides along behind the
 recorded baseline (``tools/graftlint_baseline.json``): its pre-existing
 findings are inventoried, only NEW ones fail. Any new finding fails here; a
@@ -107,7 +108,10 @@ def test_known_designed_exceptions_stay_suppressed_not_deleted():
     - the serving startup hooks blocking the (still traffic-free) event loop
       (and the shutdown hook blocking it for the bounded graceful drain);
     - the audited swallowed-exception sites (ISSUE 7): best-effort probes and
-      fallbacks whose silence IS the handling — each carries its reason.
+      fallbacks whose silence IS the handling — each carries its reason;
+    - the one deliberate kv-ref drop (v3): ``_extend_index``'s pool-rebuild
+      return path forgets every cached prefix, so the refs die with the
+      rebuilt cache.
     """
     result = run_lint(STRICT_PATHS)
     where = {(s.path.split("/")[-1], s.rule) for s in result.suppressed}
@@ -121,3 +125,17 @@ def test_known_designed_exceptions_stay_suppressed_not_deleted():
     assert ("stage.py", "swallowed-exception") in where  # unpicklable-payload fingerprint
     assert ("app.py", "swallowed-exception") in where  # dead-transport error line
     assert ("supervisor.py", "lock-discipline") in where  # _record_fault under callers' lock
+    assert ("continuous.py", "resource-leak") in where  # _extend_index's deliberate ref drop
+
+
+def test_swallowed_exception_suppression_inventory_never_grows():
+    """The v3 CFG exemptions (best-effort release, fallback binding,
+    cleanup-release handler) deleted four suppressions outright — the
+    remaining inventory is pinned so it can only shrink. A new broad handler
+    should be narrowed, handle the failure, or match an exempt shape before
+    reaching for a suppression."""
+    result = run_lint(STRICT_PATHS)
+    swallowed = [s for s in result.suppressed if s.rule == "swallowed-exception"]
+    assert len(swallowed) <= 11, "\n".join(
+        f"{s.path}:{s.line}" for s in swallowed
+    )

@@ -694,3 +694,71 @@ def test_speculative_priority_turn_taking(gpt):
     finally:
         spec.close()
     assert order.index("inter") < order.index("batch")
+
+
+# --------------------------------- preempt failure paths drop the pin
+
+
+def test_preempt_bookkeeping_failure_drops_its_pin(gpt):
+    """If the slot teardown inside ``preempt`` dies AFTER the checkpoint pin
+    was taken, the pin must be dropped before the error propagates: the
+    ``PreemptedSlot`` never reached the caller, so nobody could ever call
+    ``release_preempted`` for it."""
+    model, variables = gpt
+    engine = _engine(model, variables)
+    slot = engine.add_request([3, 1, 4, 1, 5], 14)
+    for _ in range(5):
+        engine.step()
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("slot device update failed")
+
+    engine._slot_device_update = boom
+    with pytest.raises(RuntimeError, match="slot device update failed"):
+        engine.preempt(slot)
+    assert engine.prefix_cache.pinned_blocks == 0
+
+
+def test_preempt_requeue_failure_releases_the_checkpoint(gpt):
+    """If re-queuing the victim dies after ``preempt`` returned (the
+    checkpoint is pinned but not yet owned by the queue), the batcher must
+    release it before surfacing the failure — otherwise the victim's blocks
+    stay fenced in the pool forever."""
+    from unionml_tpu.serving.faults import EngineFailure
+
+    model, variables = gpt
+    engine = DecodeEngine(
+        model, variables, num_slots=1, max_len=64, prefill_buckets=(8, 16, 32),
+        prefix_cache_blocks=64, prefix_block_size=4,
+    )
+    batcher = ContinuousBatcher(engine)
+    requeues = []
+
+    def failing_requeue(meta):
+        requeues.append(meta)
+        raise RuntimeError("scheduler requeue failed")
+
+    batcher.scheduler.requeue = failing_requeue
+
+    async def main():
+        hog = asyncio.ensure_future(batcher.generate([9, 9, 1, 2], 40, priority="batch"))
+        while not engine.num_active:
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.1)  # let the hog decode a few tokens
+        inter = asyncio.ensure_future(
+            batcher.generate([3, 1, 4], 4, priority="interactive")
+        )
+        results = await asyncio.gather(hog, inter, return_exceptions=True)
+        return results
+
+    try:
+        results = asyncio.run(asyncio.wait_for(main(), timeout=30.0))
+    finally:
+        batcher.close()
+    # the preemption really happened and really hit the failing requeue
+    assert requeues, "the interactive arrival never drove a preemption"
+    # the hog cannot survive (its re-queue failed); either structured engine
+    # failure or a propagated requeue error is acceptable — hanging is not
+    assert any(isinstance(r, (EngineFailure, RuntimeError)) for r in results)
+    # the contract under test: the orphaned checkpoint's pin was dropped
+    assert engine.prefix_cache.pinned_blocks == 0

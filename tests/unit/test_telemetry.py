@@ -42,6 +42,27 @@ def gpt(gpt_tiny_session):
     return model, variables
 
 
+@pytest.fixture(autouse=True)
+def _balanced_traces(monkeypatch):
+    """Every Telemetry a test creates must leave a balanced ring behind.
+
+    The dynamic twin of graftlint's static ``trace`` resource rule: at
+    teardown, each completed trace holds exactly one terminal ``end`` span
+    (``allow_active`` tolerates traces a test deliberately leaves open).
+    """
+    created = []
+    orig_init = Telemetry.__init__
+
+    def _recording_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        created.append(self)
+
+    monkeypatch.setattr(Telemetry, "__init__", _recording_init)
+    yield
+    for tel in created:
+        tel.assert_balanced(allow_active=True)
+
+
 def _engine(model, variables, faults=None, telemetry=None, **kw):
     kw.setdefault("num_slots", 2)
     kw.setdefault("max_len", 64)

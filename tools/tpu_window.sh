@@ -31,8 +31,10 @@ fi
 
 # graftlint gate (CPU-only, no tunnel needed): refuse to spend a TPU window
 # measuring a tree with hot-path host-sync / retrace / sharding / lock /
-# use-after-donate / lock-order / async-blocking findings — the findings
-# invalidate the serving numbers before they are taken. Widened scope (the
+# use-after-donate / lock-order / async-blocking findings or leaked
+# resources (resource-leak / double-release / unbalanced-transfer — a pin
+# leak skews every pool-pressure number) — the findings invalidate the
+# serving numbers before they are taken. Widened scope (the
 # bench scripts themselves are linted; tests ride the recorded baseline), a
 # SARIF artifact for the caller to commit/upload, and the 10s runtime budget
 # so a slow linter can never eat the tunnel window it exists to protect.

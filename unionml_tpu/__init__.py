@@ -15,7 +15,7 @@ try:  # installed-package metadata wins (reference __init__.py version-from-meta
     from importlib.metadata import version as _pkg_version
 
     __version__ = _pkg_version("unionml-tpu")
-except Exception:  # graftlint: disable=swallowed-exception -- source checkout without package metadata: the fallback version IS the handling
+except Exception:  # source checkout without package metadata: the fallback version IS the handling
     __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Model", "ModelArtifact", "BaseHyperparameters", "__version__"]

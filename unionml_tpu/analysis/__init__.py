@@ -12,6 +12,13 @@ pass over the call graph assigning values a provenance lattice
 (host / device / traced / donated) and propagating it through assignments,
 attribute stores, and call boundaries — plus three rule families built on it.
 
+v3 adds an intra-procedural control-flow graph with explicit exception edges
+(:mod:`.cfg`) and a path-sensitive resource-lifetime family on top of it
+(:mod:`.rules_resources`): paired acquire/release tracking for kv-pins,
+kv-refs, traces, slots, tickets, and file handles, with per-function
+summaries propagated over the resolved call graph and ``# owns:`` /
+``# transfers:`` / ``# holds:`` contract annotations.
+
 Rules (see ``docs/analysis.md`` for the catalog):
 
 - ``host-sync`` — host syncs / implicit transfers inside jit-traced bodies or
@@ -28,6 +35,12 @@ Rules (see ``docs/analysis.md`` for the catalog):
   calls held under a lock, interprocedural.
 - ``async-blocking`` — blocking calls inside ``async def`` handlers that
   stall the event loop.
+- ``resource-leak`` — an acquired resource (pin/ref/trace/slot/ticket/handle)
+  with a CFG path — normal or exceptional — out of the function that skips
+  every release, escape, and transfer.
+- ``double-release`` — two releases of the same resource key on one path.
+- ``unbalanced-transfer`` — ``# owns:`` / ``# transfers:`` contract comments
+  whose bodies don't release / whose callers drop the handed-over resource.
 - ``suppression`` — always-on hygiene: every ``# graftlint: disable=`` needs a
   known rule name and a reason string.
 

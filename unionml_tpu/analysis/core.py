@@ -19,6 +19,13 @@ import time per file). Three source-comment conventions drive it:
 - ``# lock-order: a < b`` declares that lock ``a`` may be held while acquiring
   lock ``b`` — a nesting the static walker cannot see (cross-thread
   protocols); the declared edges participate in lock-order cycle detection.
+- ``# owns: <resource>`` on a ``def`` line declares that the function takes
+  ownership of a resource class (see ``rules_resources``) and must release it;
+  ``# transfers: <resource>`` declares that ownership leaves through the
+  return value (callers binding the result become owners); ``# holds:
+  <resource>`` on a ``self.x = ...`` line in ``__init__`` declares an
+  attribute that stores live resources, so overwriting it without a release
+  is a leak.
 
 Suppressions anchor to LOGICAL lines: a finding anywhere inside a multi-line
 statement (or on a decorated ``def``'s signature) is silenced by a suppression
@@ -51,6 +58,13 @@ _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 _LOCK_ORDER_RE = re.compile(
     r"#\s*lock-order:\s*([A-Za-z_][A-Za-z0-9_]*)\s*(?:<|->)\s*([A-Za-z_][A-Za-z0-9_]*)"
 )
+#: resource-contract annotations (rules_resources): comma-separated resource
+#: class names from the spec table — the ``owns``/``transfers`` forms sit on a
+#: def line, the ``holds`` form on an __init__ attribute assignment
+_RESOURCE_LIST = r"([A-Za-z][A-Za-z0-9_\-]*(?:\s*,\s*[A-Za-z][A-Za-z0-9_\-]*)*)"
+_OWNS_RE = re.compile(r"#\s*owns:\s*" + _RESOURCE_LIST)
+_TRANSFERS_RE = re.compile(r"#\s*transfers:\s*" + _RESOURCE_LIST)
+_HOLDS_RE = re.compile(r"#\s*holds:\s*" + _RESOURCE_LIST)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +146,11 @@ class SourceModule:
         self.guards: Dict[int, str] = {}
         #: ``# lock-order: a < b`` hints: (line, a, b)
         self.lock_hints: List[Tuple[int, str, str]] = []
+        #: code line -> resource classes (``# owns:`` / ``# transfers:`` on a
+        #: def line, ``# holds:`` on an ``__init__`` attribute assignment)
+        self.owns: Dict[int, Tuple[str, ...]] = {}
+        self.transfers: Dict[int, Tuple[str, ...]] = {}
+        self.holds: Dict[int, Tuple[str, ...]] = {}
         #: malformed-comment findings emitted by the parse (rule ``suppression``)
         self.comment_findings: List[Finding] = []
         #: physical line -> first line of its logical statement (suppression
@@ -199,6 +218,14 @@ class SourceModule:
             order = _LOCK_ORDER_RE.search(comment)
             if order:
                 self.lock_hints.append((line, order.group(1), order.group(2)))
+            for regex, table in (
+                (_OWNS_RE, self.owns),
+                (_TRANSFERS_RE, self.transfers),
+                (_HOLDS_RE, self.holds),
+            ):
+                m = regex.search(comment)
+                if m:
+                    table[target] = tuple(r.strip() for r in m.group(1).split(","))
 
     def _parse_graftlint_comment(self, line: int, col: int, comment: str, target: int) -> None:
         marker = _MARKER_RE.search(comment)
@@ -339,6 +366,7 @@ def _load_rule_modules() -> None:
         rules_exceptions,
         rules_host_sync,
         rules_locks,
+        rules_resources,
         rules_retrace,
         rules_sharding,
     )

@@ -46,7 +46,7 @@ def device_memory_stats() -> List[Dict[str, Any]]:
     for device in jax.devices():
         try:
             raw = device.memory_stats() or {}
-        except Exception:  # graftlint: disable=swallowed-exception -- backend without memory_stats: empty stats are the documented fallback
+        except Exception:  # backend without memory_stats: empty stats are the fallback
             raw = {}
         stats.append(
             {

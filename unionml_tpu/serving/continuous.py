@@ -313,7 +313,7 @@ class DecodeEngine:
         #: requests terminated by per-slot NaN/Inf-logits quarantine
         self.quarantined_requests = 0
         #: salvage captured at the last failure, awaiting :meth:`take_salvage`
-        self._salvage: List[SalvagedSlot] = []
+        self._salvage: List[SalvagedSlot] = []  # holds: kv-pin
         #: set when an in-place rebuild itself failed: the engine refuses work
         #: until :meth:`rebuild` succeeds (the supervisor retries with backoff;
         #: unsupervised callers retry lazily via ``_ensure_usable``)
@@ -1089,6 +1089,7 @@ class DecodeEngine:
             if path:
                 self._slot_path[slot] = path
             return
+        # graftlint: disable=resource-leak -- the pool-rebuild return path drops 'full' deliberately: _rebuild_pool() forgets every cached prefix, so the refs die with the rebuilt cache
         full, new = self.prefix_cache.extend(
             path, tokens, int(tokens.size) // self._prefix_block_size
         )
@@ -1402,6 +1403,7 @@ class DecodeEngine:
             )
         self._salvage = records
 
+    # transfers: kv-pin
     def take_salvage(self) -> List[SalvagedSlot]:
         """Collect (and clear) the salvage captured by the last failure. The
         caller owns the records' eviction pins from here on — drop each via
@@ -1410,6 +1412,7 @@ class DecodeEngine:
         salvage, self._salvage = self._salvage, []
         return salvage
 
+    # owns: kv-pin
     def discard_salvage(self) -> None:
         """Unpin and drop uncollected salvage (reset/abort/unsupervised paths)."""
         for rec in self._salvage:
@@ -1905,6 +1908,7 @@ class DecodeEngine:
         self._release_prefix(slot)
         self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
 
+    # transfers: kv-pin
     def preempt(self, slot: int) -> Optional[PreemptedSlot]:  # graftlint: off-path (scheduler policy action, not steady-state decode)
         """Checkpoint a RUNNING slot into the prefix cache and free it.
 
@@ -1959,26 +1963,33 @@ class DecodeEngine:
             return None
         path = self._slot_path.pop(slot, [])
         self.prefix_cache.pin(path)  # survives LRU + the working-ref release below
-        self.prefix_cache.release(path)
-        self._slot_tokens.pop(slot, None)
-        self._active[slot] = False
-        self._reserved[slot] = False
-        self._remaining[slot] = 0
-        self._slot_temp[slot] = self.temperature
-        self._slot_top_k[slot] = 0
-        self._slot_top_p[slot] = 1.0
-        self._slot_queue_wait.pop(slot, None)
-        self.preempted_requests += 1
-        if self._telemetry is not None:
-            self._note_span(
-                slot, "preempted",
-                transcript_tokens=int(valid), pinned_blocks=len(path),
-            )
-            self._telemetry.preemptions_total.inc()
-            self._drop_rid(slot)
-        self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
+        try:
+            self.prefix_cache.release(path)
+            self._slot_tokens.pop(slot, None)
+            self._active[slot] = False
+            self._reserved[slot] = False
+            self._remaining[slot] = 0
+            self._slot_temp[slot] = self.temperature
+            self._slot_top_k[slot] = 0
+            self._slot_top_p[slot] = 1.0
+            self._slot_queue_wait.pop(slot, None)
+            self.preempted_requests += 1
+            if self._telemetry is not None:
+                self._note_span(
+                    slot, "preempted",
+                    transcript_tokens=int(valid), pinned_blocks=len(path),
+                )
+                self._telemetry.preemptions_total.inc()
+                self._drop_rid(slot)
+            self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
+        except Exception:
+            # the checkpoint never reached the caller: drop the eviction pin
+            # before propagating, or the blocks stay fenced forever
+            self.prefix_cache.unpin(path)
+            raise
         return PreemptedSlot(tokens=[int(t) for t in tokens], path=path)
 
+    # owns: kv-pin
     def release_preempted(self, state: PreemptedSlot) -> None:
         """Drop a preempted checkpoint's eviction pin — after its resume
         re-admitted (the new slot holds its own references by then) or when
@@ -2365,6 +2376,7 @@ class ContinuousBatcher:
             logger.warning("sink %s delivery failed (consumer gone?); dropping request", method)
             return False
 
+    # owns: kv-pin
     def _release_ticket(self, ticket: Any) -> None:
         """Drop a dead ticket's engine-side state: a preempted checkpoint's
         eviction pin must not outlive its request (worker thread only)."""
@@ -2372,6 +2384,7 @@ class ContinuousBatcher:
             self._engine.release_preempted(ticket.resume)
             ticket.resume = None
 
+    # owns: trace
     def _tel_end(self, ticket: Any, status: str, reason: Optional[str] = None) -> None:
         """Close a ticket's trace on terminal delivery (no-op without telemetry
         or for untraced tickets; always called OUTSIDE the batcher lock)."""
@@ -2448,28 +2461,44 @@ class ContinuousBatcher:
         )
         for _, _, slot, ticket in victims:
             state = self._engine.preempt(slot)
-            if self._engine.has_pending_events:
-                # the preempt flush ran under the OLD mapping: deliver the
-                # victim's (and survivors') flushed tokens before re-keying
-                self._dispatch_events(self._engine.take_pending_events())
-            if state is None:
-                # retired during the flush (a slot freed anyway) or not
-                # checkpointable — the dispatch above reconciled either way
-                if self._engine.free_slots:
-                    return
-                continue
-            # the sink keeps every token it already received; the ticket's
-            # prompt becomes the full transcript and its budget shrinks by
-            # the tokens already delivered, so the resumed decode continues
-            # the stream exactly where the preemption cut it
-            sink = self._sinks.pop(slot, None)
-            meta = self._slot_meta.pop(slot, ticket)
-            generated = len(state.tokens) - len(meta.prompt)
-            meta.prompt = np.asarray(state.tokens, dtype=np.int32)
-            meta.budget = int(meta.budget) - max(0, generated)
-            meta.resume = state
-            meta.sink = sink if sink is not None else meta.sink
-            self.scheduler.requeue(meta)
+            try:
+                if self._engine.has_pending_events:
+                    # the preempt flush ran under the OLD mapping: deliver the
+                    # victim's (and survivors') flushed tokens before re-keying
+                    self._dispatch_events(self._engine.take_pending_events())
+                if state is None:
+                    # retired during the flush (a slot freed anyway) or not
+                    # checkpointable — the dispatch above reconciled either way
+                    if self._engine.free_slots:
+                        return
+                    continue
+                # the sink keeps every token it already received; the ticket's
+                # prompt becomes the full transcript and its budget shrinks by
+                # the tokens already delivered, so the resumed decode continues
+                # the stream exactly where the preemption cut it
+                sink = self._sinks.pop(slot, None)
+                meta = self._slot_meta.pop(slot, ticket)
+                generated = len(state.tokens) - len(meta.prompt)
+                meta.prompt = np.asarray(state.tokens, dtype=np.int32)
+                meta.budget = int(meta.budget) - max(0, generated)
+                meta.resume = state
+                meta.sink = sink if sink is not None else meta.sink
+                self.scheduler.requeue(meta)
+            except Exception as exc:
+                # the checkpoint never reached the queue: drop its pin before
+                # propagating, or the victim's blocks stay fenced forever —
+                # and fail the victim's consumer (its sink left the slot maps
+                # above, so the engine-failure sweep can no longer reach it)
+                if state is not None:
+                    self._engine.release_preempted(state)
+                victim = self._sinks.pop(slot, None) or getattr(
+                    ticket, "sink", None
+                )
+                self._slot_meta.pop(slot, None)
+                if victim is not None:
+                    self._deliver(victim, "fail", exc)
+                self._tel_end(ticket, "error", "preempt_requeue_failed")
+                raise
             return
 
     def _admit(self) -> None:  # graftlint: off-path (admission, not steady-state decode)
@@ -2592,6 +2621,7 @@ class ContinuousBatcher:
         self._slot_meta.clear()
         self._engine.abort_all()
 
+    # owns: kv-pin
     def _handle_engine_failure(self, exc: BaseException, pending: Sequence[Any] = ()) -> None:  # graftlint: off-path (error recovery)
         """Recover from an engine-wide failure.
 

@@ -428,3 +428,41 @@ class Telemetry:
                 "journal_path": self.journal_path,
                 "spans_dropped": self._dropped_spans,
             }
+
+    def assert_balanced(self, *, allow_active: bool = False) -> None:
+        """Tests-only invariant check: every completed trace is terminated.
+
+        The dynamic twin of the static ``trace`` resource rule
+        (``new_trace`` must reach ``end_trace`` on every path): each trace in
+        the completed ring must carry exactly one terminal ``"end"`` span, it
+        must be the last span, and the trace status must no longer be
+        ``"active"``. Unless ``allow_active`` is set, no trace may still be
+        open in ``_active`` — a leftover entry means some code path acquired
+        a trace and never ended it.
+
+        Wired into test teardowns; never call this from serving paths.
+        """
+        with self._lock:
+            for trace in self._ring:
+                ends = [i for i, s in enumerate(trace.spans) if s.kind == "end"]
+                if len(ends) != 1:
+                    raise AssertionError(
+                        f"trace {trace.request_id!r} has {len(ends)} 'end' "
+                        f"spans (want exactly 1)"
+                    )
+                if ends[0] != len(trace.spans) - 1:
+                    raise AssertionError(
+                        f"trace {trace.request_id!r} has spans after 'end': "
+                        f"{[s.kind for s in trace.spans[ends[0] + 1:]]}"
+                    )
+                if trace.status == "active":
+                    raise AssertionError(
+                        f"completed trace {trace.request_id!r} still marked "
+                        f"'active'"
+                    )
+            if not allow_active and self._active:
+                raise AssertionError(
+                    "unterminated traces at teardown: "
+                    f"{sorted(self._active)} — every new_trace() must reach "
+                    f"end_trace()"
+                )
