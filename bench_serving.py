@@ -678,6 +678,115 @@ def bench_pipeline(modes=("on", "off"), n_requests: int = 8, max_new_tokens: int
     return out
 
 
+def bench_paged(modes=("on", "off"), n_requests: int = 16, prompt_len: int = 6,
+                max_new_tokens: int = 24, mesh_devices: int = 0):
+    """Paged-vs-dense KV A/B at EQUAL KV byte budget
+    (``bench_serving.py --paged {on,off,ab}``).
+
+    Both arms get exactly 256 cached token positions of KV: dense reserves
+    them as 4 rigid ``max_len=64`` slot rows, so 4 requests decode
+    concurrently no matter how short they are; paged pools them as 64
+    four-token blocks (65 with the scratch block) behind a block table, so a
+    request only holds ``ceil((len+budget)/4)`` blocks and short requests
+    pack the same bytes 2x+ deeper. Reported per arm: measured PEAK
+    concurrency, decode tok/s, wall time, and the per-request-footprint
+    slots-vs-memory curve (concurrent requests each arm fits at this byte
+    budget, by request length). The ``ab`` mode gates: paged must fit
+    >= 1.5x the concurrent requests AND the two arms' greedy streams must
+    be token-identical, else the battery step fails."""
+    config, model, variables = _bench_gpt()
+    mesh = _serving_mesh(mesh_devices, config.num_heads) if mesh_devices else None
+
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    BS, MAX_LEN, KV_TOKENS = 4, 64, 256  # the shared byte budget, in positions
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, config.vocab_size, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def run(paged: bool):
+        if paged:
+            # 64 usable blocks + the scratch block: 256 positions, same bytes
+            engine = DecodeEngine(
+                model, variables, num_slots=16, max_len=MAX_LEN,
+                prefill_buckets=(8,), mesh=mesh, paged=True,
+                pool_blocks=KV_TOKENS // BS + 1, prefix_block_size=BS,
+                prefix_cache_blocks=0,
+            )
+        else:
+            engine = DecodeEngine(
+                model, variables, num_slots=KV_TOKENS // MAX_LEN, max_len=MAX_LEN,
+                prefill_buckets=(8,), mesh=mesh, paged=False,
+            )
+        engine.generate(prompts[0], 4)  # warm the prefill/decode programs
+        base_tokens = engine.tokens_decoded
+        pending = list(enumerate(prompts))
+        streams = {i: [] for i in range(n_requests)}
+        req_of_slot = {}
+        peak = 0
+        with _RetraceCounter() as retraces:
+            t0 = time.perf_counter()
+            while pending or engine.num_active or engine.has_pending_events:
+                while pending and engine.free_slots:
+                    i, p = pending[0]
+                    avail = engine.available_blocks()
+                    if avail is not None and engine.block_demand(len(p), max_new_tokens) > avail:
+                        break  # block-gated (the batcher's admission rule)
+                    pending.pop(0)
+                    (slot,) = engine.admit_many([(p, max_new_tokens)])
+                    req_of_slot[slot] = i
+                peak = max(peak, engine.num_active)
+                for ev in engine.step():
+                    if ev.emit:
+                        streams[req_of_slot[ev.slot]].append(ev.token)
+            elapsed = time.perf_counter() - t0
+        decoded = engine.tokens_decoded - base_tokens
+        return {
+            "decode_tok_s": round(decoded / elapsed, 1),
+            "total_s": round(elapsed, 4),
+            "tokens": decoded,
+            "retraces": retraces.count,
+            "peak_concurrent": peak,
+            "kv_token_budget": KV_TOKENS,
+        }, streams
+
+    footprint = prompt_len + max_new_tokens
+    out = {
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "request_kv_footprint": footprint,
+        "mesh_devices": mesh_devices or 1,
+        # the slots-vs-memory curve: concurrent requests each arm fits into
+        # the SAME 256 cached positions, by per-request KV footprint
+        "slots_vs_memory": {
+            str(length): {
+                "dense_concurrent": KV_TOKENS // MAX_LEN,
+                "paged_concurrent": (KV_TOKENS // BS) // -(-length // BS),
+            }
+            for length in (8, 16, 32, 64)
+        },
+    }
+    streams_by_mode = {}
+    for mode in modes:
+        entry, streams = run(mode == "on")
+        out["paged_" + mode] = entry
+        streams_by_mode[mode] = streams
+    if "paged_on" in out and "paged_off" in out:
+        out["concurrency_ratio"] = round(
+            out["paged_on"]["peak_concurrent"]
+            / max(out["paged_off"]["peak_concurrent"], 1), 3
+        )
+        out["speedup_tok_s"] = round(
+            out["paged_on"]["decode_tok_s"]
+            / max(out["paged_off"]["decode_tok_s"], 1e-9), 3
+        )
+        out["token_identical"] = streams_by_mode["on"] == streams_by_mode["off"]
+    return out
+
+
 def bench_obs(modes=("on", "off"), n_requests: int = 16, max_new_tokens: int = 32,
               repeats: int = 3, mesh_devices: int = 0):
     """Telemetry ON-vs-OFF A/B: the same concurrent request mix through the
@@ -1224,6 +1333,16 @@ def main():
                         "phase (like --mesh) so the hardware-window battery can time "
                         "the A/B without re-paying the MLP/BERT benches; combine with "
                         "--mesh N to run it over an N-device mesh")
+    parser.add_argument("--paged", choices=("on", "off", "ab"), default=None,
+                        help="focused paged-vs-dense KV phase: peak concurrent "
+                        "requests + decode tok/s at EQUAL KV byte budget (256 "
+                        "cached positions as a 4-token block pool vs rigid "
+                        "max_len=64 slot rows), plus the slots-vs-memory curve "
+                        "('ab' runs the pair and GATES: paged must fit >= 1.5x "
+                        "the concurrent requests with token-identical greedy "
+                        "streams, else exits nonzero). Runs ONLY this phase "
+                        "(like --pipeline); combine with --mesh N for the "
+                        "head-sharded pool")
     parser.add_argument(
         "--out",
         default="SERVING_BENCH.json",
@@ -1238,12 +1357,15 @@ def main():
     from bench_util import resolve_artifact_path
 
     backend = jax.default_backend()
-    if args.pipeline or args.mesh or args.slo_mix or args.chaos or args.fleet or args.obs:
+    if (args.pipeline or args.mesh or args.slo_mix or args.chaos or args.fleet
+            or args.obs or args.paged):
         import os
 
         base, ext = os.path.splitext(args.out)
         if args.pipeline:
             base = f"{base}_pipeline"
+        if args.paged:
+            base = f"{base}_paged"
         if args.obs:
             base = f"{base}_obs"
         if args.slo_mix:
@@ -1377,6 +1499,37 @@ def main():
         with open(args.out, "w") as fh:
             json.dump(results, fh, indent=2)
         print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        return 0
+
+    if args.paged:
+        if args.mesh and len(jax.devices()) < args.mesh:
+            print(json.dumps({"metric": "paged_peak_concurrent",
+                              "error": f"--mesh {args.mesh} needs {args.mesh} devices, "
+                              f"found {len(jax.devices())}", "backend": backend}))
+            return 1
+        modes = ("on", "off") if args.paged == "ab" else (args.paged,)
+        ab = bench_paged(modes=modes, mesh_devices=args.mesh)
+        results["models"]["paged_ab" if len(modes) == 2 else f"paged_{modes[0]}"] = ab
+        line = {"metric": "paged_peak_concurrent", "backend": backend,
+                "mesh_devices": args.mesh or 1,
+                "kv_token_budget": ab[f"paged_{modes[0]}"]["kv_token_budget"]}
+        for mode in modes:
+            line[f"peak_concurrent_{mode}"] = ab[f"paged_{mode}"]["peak_concurrent"]
+            line[f"tok_s_{mode}"] = ab[f"paged_{mode}"]["decode_tok_s"]
+        if len(modes) == 2:
+            line["concurrency_ratio"] = ab["concurrency_ratio"]
+            line["speedup_tok_s"] = ab["speedup_tok_s"]
+            line["token_identical"] = ab["token_identical"]
+        print(json.dumps(line))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        # the A/B GATES the tentpole's claim: at the same KV bytes, paged must
+        # pack >= 1.5x the concurrent requests without changing a single token
+        if len(modes) == 2 and not (
+            ab["concurrency_ratio"] >= 1.5 and ab["token_identical"]
+        ):
+            return 1
         return 0
 
     if args.mesh:

@@ -83,10 +83,14 @@ def test_sharded_engine_tokens_byte_identical(gpt, expected, axes):
 
 
 def test_sharded_cache_is_head_sharded(gpt):
-    """The KV cache actually shards over heads on the tensor axis (not replicated)."""
+    """The dense-compat KV cache shards over heads on the tensor axis (not
+    replicated). The paged pool's equivalent layout is asserted in
+    test_prefix_cache.py::test_mesh_pool_is_head_sharded."""
     model, variables = gpt
     mesh = _mesh({"tensor": 4})
-    engine = DecodeEngine(model, variables, num_slots=2, max_len=32, prefill_buckets=(8,), mesh=mesh)
+    engine = DecodeEngine(
+        model, variables, num_slots=2, max_len=32, prefill_buckets=(8,), mesh=mesh, paged=False
+    )
     leaf = engine._cache["layer_0"]["k"]  # (slots, heads=4, max_len, head_dim)
     assert len(leaf.sharding.device_set) == 4
     # each device holds 1 of the 4 heads

@@ -161,12 +161,14 @@ def test_sampled_fixed_seed_parity(gpt):
 
 
 def test_evict_then_readmit_parity(gpt, gpt_tiny_solo):
-    """A 3-block pool under 3 competing prefixes: hits, evictions, and misses on
-    evicted prefixes all stay token-identical; counters record the churn."""
+    """A tiny unified pool under 3 competing prefixes: hits, evictions, and
+    misses on evicted prefixes all stay token-identical; counters record the
+    churn. (Paged engines size the tree out of the shared block pool, so the
+    pressure comes from an explicit small ``pool_blocks``.)"""
     a = list(range(1, 11))
     b = list(range(50, 60))
     c = list(range(80, 90))
-    engine = make_engine(gpt, blocks=3)
+    engine = make_engine(gpt, blocks=3, pool_blocks=7)
     for prompt in (a, b, a, c, a, b):
         assert engine.generate(prompt, 4) == gpt_tiny_solo(prompt, 4)
     stats = engine.prefix_cache.stats()

@@ -94,6 +94,11 @@ run serving_mesh 420 python bench_serving.py --mesh 4
 # depth-1 pipelined decode A/B: dispatch-ahead on vs off at lookahead=1 —
 # decode tok/s + host-gap ms (the host sync this battery's tunnel magnifies)
 run serving_pipeline 300 python bench_serving.py --pipeline ab
+# paged-vs-dense KV A/B at equal KV byte budget: peak concurrent requests,
+# decode tok/s, and the slots-vs-memory curve (the phase exits nonzero when
+# paged packs < 1.5x the concurrent requests or the greedy streams diverge
+# by a single token — the tentpole's claim, measured on hardware)
+run serving_paged 300 python bench_serving.py --paged ab
 # telemetry overhead A/B: span tracing + metrics on vs off over the same
 # concurrent mix — best-of-3 decode tok/s per arm (the phase exits nonzero
 # when the enabled arm regresses more than 2%, holding the zero-overhead

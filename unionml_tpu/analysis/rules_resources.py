@@ -22,6 +22,9 @@ class     acquires                                     releases
 ========  ===========================================  =============================
 kv-pin    ``*prefix_cache*.pin(k)``,                   ``*prefix_cache*.unpin(k)``,
           ``k = *engine*.preempt(...)``                ``*engine*.release_preempted(k)``
+kv-block  ``k = *allocator*.alloc_blocks(...)``,       ``*allocator*.free_blocks(k)``,
+          ``k = self._alloc_slot_blocks(...)``         ``self._free_slot_blocks(k)``,
+                                                       ``*prefix_cache*.adopt(k, ...)``
 kv-ref    ``k, _ = *prefix_cache*.match(...)``,        ``*prefix_cache*.release(k)``
           ``k, _ = *prefix_cache*.extend(...)``
 trace     ``k = *telemetry*.new_trace(...)``           ``*telemetry*.end_trace(k)``
@@ -149,6 +152,28 @@ SPECS: Tuple[ResourceSpec, ...] = (
         releases=(
             Sig("unpin", "prefix_cache", "arg"),
             Sig("release_preempted", "engine", "arg"),
+        ),
+        strict=True,
+        exit_leak=False,
+    ),
+    ResourceSpec(
+        # paged serving: a block-table grant out of the shared KV pool. The
+        # engine acquires on admit/splice (_alloc_slot_blocks, which records
+        # the grant in _slot_block_map and returns the ids — '# transfers:'),
+        # and releases on finish/cancel/preempt/rebuild (_free_slot_blocks,
+        # the '# owns:' release point) or by adoption into the radix index.
+        "kv-block",
+        "slot-owned KV block grant",
+        acquires=(
+            Sig("alloc_blocks", "allocator", "result"),
+            # empty hint, NOT "self": a "self" hint would enter _ALL_HINTS and
+            # exempt every self.<method>(key) call from escape analysis
+            Sig("_alloc_slot_blocks", "", "result"),
+        ),
+        releases=(
+            Sig("free_blocks", "allocator", "arg"),
+            Sig("_free_slot_blocks", "", "arg"),
+            Sig("adopt", "prefix_cache", "arg"),
         ),
         strict=True,
         exit_leak=False,

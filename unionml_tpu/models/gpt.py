@@ -85,6 +85,7 @@ class DecoderBlock(nn.Module):
         deterministic: bool,
         pad_offsets: Optional[jax.Array] = None,
         segment_ids: Optional[jax.Array] = None,
+        block_table: Optional[jax.Array] = None,
     ):
         """Full-sequence (cache=None) or single-token incremental (cache given) step.
 
@@ -97,6 +98,18 @@ class DecoderBlock(nn.Module):
         row's offset are masked for that row. ``segment_ids`` (batch, seq) selects
         packed-sequence training (cache=None only): causal attention additionally
         confined to same-segment tokens. Returns (hidden, new_cache).
+
+        Paged contract (``block_table`` given): ``cache`` holds ``{"k","v"}`` pool
+        leaves of shape (num_blocks, heads, block_size, head_dim) shared by every
+        row, and ``block_table`` is an int32 (batch, width) map from a row's
+        logical block index to its pool block. Token position ``p`` lives at
+        block ``table[row, p // block_size]``, offset ``p % block_size``. Writes
+        scatter into the tail block in place; reads gather the row's table —
+        contiguous logical order, so the mask arithmetic is identical to the
+        dense path and outputs match it bitwise (masked columns hit exp(-inf)=0
+        exactly). The engine keeps the last table column pointed at a scratch
+        block and encodes retired rows' positions past ``(width-1)*block_size``,
+        so their unavoidable scatter lands in scratch, never in a reused block.
         """
         cfg = self.config
         batch, seq, _ = hidden.shape
@@ -141,6 +154,55 @@ class DecoderBlock(nn.Module):
                 # causal=True supplies the triangular part; only the pad mask is ours
                 context = xla_attention(q, k, v, causal=True, mask=pad_mask(jnp.arange(seq)))
             new_cache = None
+        elif block_table is not None:
+            per_row = not isinstance(position, int) and jnp.ndim(position) == 1
+            if per_row and seq != 1:
+                raise ValueError("per-row cache positions require single-token decode (seq=1)")
+            if pad_offsets is not None:
+                raise ValueError("paged decode does not support pad_offsets (left-padded rows)")
+            block_size = cache["k"].shape[2]
+            width = block_table.shape[1]
+            capacity = width * block_size
+            if per_row:
+                # decode: each row appends one token into its own tail block
+                pos = jnp.clip(position.astype(jnp.int32), 0, capacity - 1)
+                blk, off = pos // block_size, pos % block_size
+                dst = jnp.take_along_axis(block_table, blk[:, None], axis=1)[:, 0]
+                k_cache = cache["k"].at[dst, :, off, :].set(k[:, :, 0, :].astype(cache["k"].dtype))
+                v_cache = cache["v"].at[dst, :, off, :].set(v[:, :, 0, :].astype(cache["v"].dtype))
+            else:
+                # chunked prefill through the table (batch=1): scatter the chunk's
+                # K/V at positions [position, position+seq) of row 0's blocks
+                if batch != 1:
+                    raise ValueError("paged chunk prefill requires batch == 1")
+                pos = jnp.clip((position + jnp.arange(seq)).astype(jnp.int32), 0, capacity - 1)
+                blk, off = pos // block_size, pos % block_size
+                dst = jnp.take(block_table[0], blk)
+                k_cache = cache["k"].at[dst, :, off, :].set(
+                    jnp.moveaxis(k[0], 1, 0).astype(cache["k"].dtype)
+                )
+                v_cache = cache["v"].at[dst, :, off, :].set(
+                    jnp.moveaxis(v[0], 1, 0).astype(cache["v"].dtype)
+                )
+
+            def gather_table(pool_leaf):
+                # (batch, width, heads, bs, hd) -> (batch, heads, width*bs, hd):
+                # logical position p lands at flattened column blk*bs+off == p,
+                # so downstream masking is position arithmetic, same as dense
+                blocks = pool_leaf[block_table]
+                return jnp.moveaxis(blocks, 2, 1).reshape(
+                    batch, cfg.num_heads, capacity, cfg.head_dim
+                )
+
+            k_pos = jnp.arange(capacity)
+            if per_row:
+                q_pos = position[:, None] + jnp.arange(seq)[None, :]  # (batch, seq)
+                mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, :, :]
+            else:
+                q_pos = position + jnp.arange(seq)
+                mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+            context = xla_attention(q, gather_table(k_cache), gather_table(v_cache), mask=mask)
+            new_cache = {"k": k_cache, "v": v_cache}
         else:
             per_row = not isinstance(position, int) and jnp.ndim(position) == 1
             if per_row and seq != 1:
@@ -243,6 +305,12 @@ class GPTLMHeadModel(nn.Module):
         segments), attention is confined to same-segment tokens (flash-kernel
         blockwise masking — no dense (seq, seq) mask), and position embeddings
         restart at each segment start. See :func:`unionml_tpu.ops.packing.pack_sequences`.
+
+        A ``cache`` carrying a ``"table"`` key selects PAGED decoding: the layer
+        entries are shared block-pool leaves (see :func:`init_block_pool`) and
+        ``cache["table"]`` is the int32 (batch, width) block table every layer
+        reads/writes through (one table, all layers — the pool is per-layer, the
+        logical layout is not). The table rides through ``new_cache`` unchanged.
         """
         cfg = self.config
         if pad_offsets is not None and cfg.moe_every > 0 and not deterministic:
@@ -282,6 +350,7 @@ class GPTLMHeadModel(nn.Module):
         hidden = nn.Dropout(cfg.dropout)(hidden, deterministic=deterministic)
 
         new_cache: Dict[str, Any] = {}
+        block_table = cache.get("table") if cache is not None else None
         block_cls = DecoderBlock
         if cfg.remat and cache is None:
             # training forwards only: decode steps are tiny and cache-carrying
@@ -291,10 +360,13 @@ class GPTLMHeadModel(nn.Module):
             layer_cache = None if cache is None else cache[f"layer_{i}"]
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
             hidden, layer_cache = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(
-                hidden, layer_cache, position, deterministic, pad_offsets, segment_ids
+                hidden, layer_cache, position, deterministic, pad_offsets, segment_ids,
+                block_table,
             )
             if layer_cache is not None:
                 new_cache[f"layer_{i}"] = layer_cache
+        if block_table is not None:
+            new_cache["table"] = block_table
 
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="final_norm")(hidden)
         # tied head with genuinely-f32 logits: Embed.attend would promote back to the
@@ -398,6 +470,23 @@ def advance_slot_state(
     if eos_token_id is not None:
         finished = finished | (tokens == eos_token_id)
     return active & ~finished, new_remaining
+
+
+def block_table_width(max_len: int, block_size: int) -> int:
+    """Columns in a slot's block-table row: ``ceil(max_len / block_size)`` data
+    blocks plus one trailing scratch column (always mapped to the engine's
+    scratch block) that absorbs the masked scatter of retired rows."""
+    return -(-max_len // block_size) + 1
+
+
+def init_block_tables(
+    num_slots: int, max_len: int, block_size: int, scratch_id: int
+) -> jax.Array:
+    """int32 ``(num_slots, width)`` block tables, every entry on the scratch
+    block: a fresh table maps nothing, and any write through it lands in
+    scratch. See :func:`block_table_width` for the trailing scratch column."""
+    width = block_table_width(max_len, block_size)
+    return jnp.full((num_slots, width), scratch_id, dtype=jnp.int32)
 
 
 def kv_block_spec(config: GPTConfig, mesh_axis_names: Tuple[str, ...]) -> Any:

@@ -204,6 +204,27 @@ class DecodeEngine:
         ``pipeline=False`` (events are simply delivered one tick later).
         ``cancel``/``abort_all``/``reset`` flush or discard the in-flight
         step, so no stale token is ever applied to a reused slot.
+    :param paged: PAGED KV decode (default on): the block pool is the ONLY KV
+        storage — a slot's "cache" is an int32 block-table row plus a length,
+        attention gathers K/V through the table inside the compiled step, and
+        decode writes each new token into the slot's tail block in place.
+        Admission allocates ``ceil(min(prompt+budget, max_len)/block_size)``
+        blocks instead of reserving a dense ``max_len`` row, so concurrency is
+        bounded by LIVE tokens, not worst-case length; exhaustion raises the
+        structured ``EngineFailure(reason="pool_exhausted", retryable=True)``.
+        Prefix-cache hits splice shared pool blocks straight into the table
+        (no restore copy) and retiring slots index their blocks by adoption
+        (no save copy). Outputs are token-identical to ``paged=False``: the
+        gathered table is a contiguous logical view, masked columns contribute
+        exactly zero, and the engine's scheduling is unchanged. ``False``
+        selects the legacy dense per-slot caches (the A/B bench arm).
+    :param pool_blocks: total pool size in blocks for paged mode (including
+        one reserved scratch block that absorbs retired rows' masked writes).
+        Default ``None`` sizes the pool so block admission can never fail when
+        a slot is free — ``num_slots * ceil(max_len/block_size) +
+        prefix_cache_blocks + 1`` — i.e. dense-equivalent capacity semantics;
+        pass an explicit smaller value to serve more concurrent short requests
+        than dense could at the same KV byte budget (the paged bench arm).
     :param faults: a :class:`~unionml_tpu.serving.faults.FaultPlan` arming
         deterministic fault injection (chaos tests and ``bench_serving
         --chaos`` only). ``None`` (production) makes every hook a single host
@@ -229,6 +250,8 @@ class DecodeEngine:
         prefix_block_size: int = 16,
         prefix_cache_generated: bool = False,
         pipeline: bool = True,
+        paged: bool = True,
+        pool_blocks: Optional[int] = None,
         faults: Optional[FaultPlan] = None,
         telemetry: Optional[Any] = None,
     ) -> None:
@@ -398,6 +421,42 @@ class DecodeEngine:
         self._pool: Optional[Any] = None
         self._slot_path: Dict[int, List[Any]] = {}
         self._slot_tokens: Dict[int, List[int]] = {}
+
+        #: paged KV decode: the pool is the ONLY KV storage (no dense cache)
+        self.paged = bool(paged)
+        #: block allocator backing the paged pool; doubles as the radix index
+        #: when prefix caching is enabled. None on dense engines.
+        self._allocator: Optional[Any] = None
+        #: per-slot PRIVATE blocks: block index -> pool block id the slot owns
+        #: (shared spliced prefix entries live in _slot_path, not here).
+        #: Freeing on retirement is safe even with a step in flight: every
+        #: pool WRITE chains through the pool's donation (admission inserts
+        #: queue after the in-flight step), and a reused block's new positions
+        #: are always written by the new owner before its attention reads them.
+        self._slot_block_map: Dict[int, Dict[int, int]] = {}  # holds: kv-block
+        self._explicit_pool_blocks = pool_blocks is not None
+        if self.paged:
+            from unionml_tpu.models.gpt import block_table_width
+            from unionml_tpu.serving.prefix_cache import PrefixCache
+
+            # the pool's block size IS the prefix cache's block size (one
+            # layout, spliced freely); clamp so short-context engines with the
+            # default granularity still page
+            bs = min(int(prefix_block_size), max_len)
+            self._prefix_block_size = bs
+            self._table_width = block_table_width(max_len, bs)
+            per_slot = self._table_width - 1  # data columns (excludes scratch)
+            if pool_blocks is None:
+                # dense-equivalent capacity: a free slot can always allocate
+                pool_blocks = num_slots * per_slot + int(prefix_cache_blocks) + 1
+            if int(pool_blocks) < 2:
+                raise ValueError(f"pool_blocks must be >= 2 (1 usable + scratch), got {pool_blocks}")
+            self.pool_blocks = int(pool_blocks)
+            #: reserved block absorbing retired rows' masked scatter; never allocated
+            self._scratch_block = self.pool_blocks - 1
+            self._allocator = PrefixCache(
+                self.pool_blocks - 1, bs, telemetry=self._telemetry
+            )
 
         self._init_device_state()
         self._sync_sampling_mirrors()
@@ -577,6 +636,133 @@ class DecodeEngine:
 
         self._save_fn = jax.jit(_save, static_argnums=(5,), donate_argnums=(0,))
 
+        if self.paged:
+            block_size = self._prefix_block_size
+            # retired rows' positions park on the trailing scratch column:
+            # >= (width-1)*block_size maps every masked write to table[:, -1]
+            sentinel = (self._table_width - 1) * block_size
+
+            def _decode_body_paged(
+                variables, pool, tables, last_logits, lens, active, key, temp, top_k, top_p,
+                *, sampling,
+            ):
+                """Paged twin of ``_decode_body``: same sampling/freeze/key
+                rules, but K/V reads gather through the block tables and the
+                token write scatters into each row's tail block. Tables ride as
+                a NON-donated input — they change only at admission, between
+                dispatches, so an in-flight step always reads a consistent map."""
+                from unionml_tpu.ops.sampling import sample_logits
+
+                variables = maybe_dequant(variables)
+                new_key, subkey = jax.random.split(key)
+                new_key = jnp.where(jnp.any(active), new_key, key)
+                bad = ~jnp.all(jnp.isfinite(last_logits), axis=-1)
+                if sampling:
+                    tokens = sample_logits(last_logits, subkey, temp, top_k, top_p)
+                else:
+                    tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+                # a retired row still scatters one K/V column per step (the
+                # program is unmasked); aiming its position at the sentinel
+                # sends that write to scratch, so a freed block can be re-owned
+                # by another slot without this row's stale table corrupting it
+                pos = jnp.where(active, lens, sentinel)
+                cache = {"table": tables, **pool}
+                logits, new_cache = model.apply(variables, tokens[:, None], cache=cache, position=pos)
+                pool = {name: leaf for name, leaf in new_cache.items() if name != "table"}
+                pool = _constrain_cache(pool)
+                new_lens = jnp.where(active, jnp.minimum(lens + 1, max_len - 1), lens)
+                new_logits = jnp.where(active[:, None], logits[:, -1, :], last_logits)
+                return pool, new_logits, new_lens, tokens, new_key, bad
+
+            def _make_step_paged(n_steps: int, sampling: bool):
+                """Paged ``_make_step``: identical scan/lifecycle contract; the
+                carried KV state is the (donated) pool instead of a dense cache."""
+                from unionml_tpu.models.gpt import advance_slot_state
+
+                def _multi(
+                    variables, pool, tables, last_logits, lens, active, remaining, key,
+                    temp, top_k, top_p,
+                ):
+                    def body(carry, _):
+                        pool, last_logits, lens, active, remaining, key = carry
+                        pool, new_logits, new_lens, tokens, key, bad = _decode_body_paged(
+                            variables, pool, tables, last_logits, lens,
+                            active, key, temp, top_k, top_p, sampling=sampling,
+                        )
+                        new_active, new_remaining = advance_slot_state(
+                            active, remaining, new_lens, tokens, max_len, eos_token_id
+                        )
+                        carry = (pool, new_logits, new_lens, new_active, new_remaining, key)
+                        return carry, (tokens, active, bad)
+
+                    carry = (pool, last_logits, lens, active, remaining, key)
+                    (pool, last_logits, lens, active, remaining, key), (toks, masks, bads) = (
+                        jax.lax.scan(body, carry, None, length=n_steps)
+                    )
+                    return pool, last_logits, lens, active, remaining, key, toks, masks, bads
+
+                return jax.jit(_multi, donate_argnums=(1, 3))
+
+            self._make_step = _make_step_paged
+
+            def _paged_insert(pool, tables, lens, last_logits, local_cache, local_logits, slots, lengths):
+                """Scatter a batched bucket prefill's dense workspace into the
+                admitted slots' pool blocks through their table rows. Padded
+                columns past a slot's allocation map to scratch (the rows'
+                unmapped tail), so the scatter needs no per-row length mask."""
+                rows_tables = tables[slots]  # (rows, width)
+                bucket = jax.tree_util.tree_leaves(local_cache)[0].shape[2]
+                cols = jnp.arange(bucket)
+                blk, off = cols // block_size, cols % block_size
+                dst = rows_tables[:, blk]  # (rows, bucket)
+
+                def put(pool_leaf, local_leaf):
+                    src = jnp.moveaxis(local_leaf, 2, 1).astype(pool_leaf.dtype)
+                    return pool_leaf.at[dst, :, off[None, :], :].set(src)
+
+                pool = _constrain_cache(jax.tree_util.tree_map(put, pool, local_cache))
+                return (
+                    pool,
+                    lens.at[slots].set(lengths.astype(lens.dtype)),
+                    last_logits.at[slots].set(local_logits.astype(jnp.float32)),
+                )
+
+            self._paged_insert_fn = jax.jit(_paged_insert, donate_argnums=(0, 2, 3))
+
+            def _paged_chunk(variables, chunk_ids, pool, tables, slot, position):
+                """One batch-1 prefill chunk written STRAIGHT into the slot's
+                pool blocks through its table row (no local workspace): this is
+                both the chunked-prefill tick and the prefix-hit suffix — the
+                matched prefix is already pool-resident behind the same table,
+                so attending over the gathered row IS the copy-free restore."""
+                variables = maybe_dequant(variables)
+                row = jax.lax.dynamic_slice_in_dim(tables, slot, 1, axis=0)  # (1, width)
+                cache = {"table": row, **pool}
+                logits, new_cache = model.apply(variables, chunk_ids, cache=cache, position=position)
+                pool = {name: leaf for name, leaf in new_cache.items() if name != "table"}
+                return logits, _constrain_cache(pool)
+
+            self._paged_chunk_fn = jax.jit(_paged_chunk, donate_argnums=(2,))
+
+            def _write_row(tables, slot, row):
+                """Point-update one slot's table row at admission (explicit
+                device_put operands; the in-flight step keeps the OLD tables
+                array, so this is pipelining-safe like _slot_update)."""
+                return tables.at[slot].set(row)
+
+            self._write_row_fn = jax.jit(_write_row, donate_argnums=(0,))
+
+            def _finish_slot(lens, last_logits, slot, length, last):
+                """Seal a table-resident prefill (chunked final tick / prefix
+                suffix): the KV is already in the slot's blocks, only the
+                length and sampling logits need the point-update."""
+                return (
+                    lens.at[slot].set(length),
+                    last_logits.at[slot].set(last[0].astype(jnp.float32)),
+                )
+
+            self._finish_slot_fn = jax.jit(_finish_slot, donate_argnums=(0, 1))
+
         if prefix_cache_blocks:
             self.enable_prefix_cache(
                 prefix_cache_blocks, prefix_block_size, cache_generated=prefix_cache_generated
@@ -585,22 +771,41 @@ class DecodeEngine:
     # ------------------------------------------------------------------ scheduling
 
     def _init_device_state(self) -> None:
-        """(Re)allocate the device-side state, laid out on the mesh when sharded."""
-        from unionml_tpu.models.gpt import init_cache, init_slot_state
+        """(Re)allocate the device-side state, laid out on the mesh when sharded.
 
-        cache = init_cache(self._config, self.num_slots, self.max_len)
+        Paged mode allocates the block pool + per-slot block tables instead of
+        the dense per-slot cache — the pool is the ONLY KV storage, so this is
+        also where a rebuild discards a poisoned pool (the step donates it)."""
+        from unionml_tpu.models.gpt import (
+            init_block_pool, init_block_tables, init_cache, init_slot_state,
+        )
+
+        if self.paged:
+            self._cache = None
+            pool = init_block_pool(self._config, self.pool_blocks, self._prefix_block_size)
+            tables = init_block_tables(
+                self.num_slots, self.max_len, self._prefix_block_size, self._scratch_block
+            )
+        else:
+            self._cache = init_cache(self._config, self.num_slots, self.max_len)
         lens = jnp.zeros((self.num_slots,), jnp.int32)
         last_logits = jnp.zeros((self.num_slots, self._config.vocab_size), jnp.float32)
         key = jax.random.PRNGKey(self._seed + self._resets)
         active, remaining = init_slot_state(self.num_slots)
         if self._mesh is not None:
-            cache = jax.device_put(cache, self._cache_sharding)
+            if self.paged:
+                pool = jax.device_put(pool, self._cache_sharding)
+                tables = jax.device_put(tables, self._replicated)
+            else:
+                self._cache = jax.device_put(self._cache, self._cache_sharding)
             lens = jax.device_put(lens, self._replicated)
             last_logits = jax.device_put(last_logits, self._replicated)
             key = jax.device_put(key, self._replicated)
             active = jax.device_put(active, self._replicated)
             remaining = jax.device_put(remaining, self._replicated)
-        self._cache, self._lens, self._last_logits, self._key = cache, lens, last_logits, key
+        if self.paged:
+            self._pool, self._tables = pool, tables
+        self._lens, self._last_logits, self._key = lens, last_logits, key
         self._active_dev, self._remaining_dev = active, remaining
         # any dispatched-but-unfetched step referenced the old buffers: dead now
         self._inflight = None
@@ -650,6 +855,35 @@ class DecodeEngine:
             raise ValueError(
                 f"prefix_block_size must be in [1, max_len) = [1, {self.max_len}), got {block_size}"
             )
+        if self.paged:
+            # the allocator IS the index: indexing just turns on over the same
+            # pool the slots already page through. A post-construction call
+            # (serving-app plumbing) may change the block size / add headroom,
+            # which re-lays-out the pool — only legal while nothing is held.
+            from unionml_tpu.models.gpt import block_table_width
+
+            width = block_table_width(self.max_len, block_size)
+            pool_blocks = self.pool_blocks
+            if not self._explicit_pool_blocks:
+                pool_blocks = self.num_slots * (width - 1) + int(num_blocks) + 1
+            if block_size != self._prefix_block_size or pool_blocks != self.pool_blocks:
+                if self.busy or self._inflight is not None or self._allocator.slot_blocks:
+                    raise RuntimeError(
+                        "enable_prefix_cache cannot re-layout the block pool while "
+                        "requests hold blocks; call it before admitting work"
+                    )
+                self._prefix_block_size = block_size
+                self._table_width = width
+                self.pool_blocks = pool_blocks
+                self._scratch_block = pool_blocks - 1
+                self._allocator = PrefixCache(
+                    pool_blocks - 1, block_size, telemetry=self._telemetry
+                )
+                self._init_device_state()
+                self._sync_sampling_mirrors()
+            self.prefix_cache = self._allocator
+            self.prefix_cache_generated = bool(cache_generated)
+            return
         self.prefix_cache = PrefixCache(int(num_blocks), block_size, telemetry=self._telemetry)
         self.prefix_cache_generated = bool(cache_generated)
         self._prefix_block_size = block_size
@@ -712,6 +946,17 @@ class DecodeEngine:
             # than the largest bucket (its blocks pinned) still re-admits
             if not self._prefix_coverable(prompt):
                 raise
+        if self.paged:
+            demand = self.block_demand(prompt.size, max_new_tokens)
+            if demand > self._allocator.num_blocks:
+                # PERMANENT: no amount of retirement frees enough blocks, so
+                # reject now (ValueError) instead of the retryable
+                # pool_exhausted failure transient contention raises
+                raise ValueError(
+                    f"request needs {demand} KV blocks but the pool has only "
+                    f"{self._allocator.num_blocks}; raise pool_blocks or lower "
+                    "max_new_tokens"
+                )
         return prompt, int(max_new_tokens), float(temperature), int(top_k), float(top_p)
 
     def _prefix_coverable(self, prompt: np.ndarray) -> bool:
@@ -732,6 +977,97 @@ class DecodeEngine:
             return covered + self.bucket_for(int(prompt.size) - covered) <= self.max_len
         except ValueError:
             return False
+
+    # ------------------------------------------------------------- paged blocks
+
+    def block_demand(self, prompt_len: int, budget: int) -> int:
+        """Pool blocks one request needs for its WHOLE lifetime: prompt plus
+        budget, capped by cache capacity (generation force-finishes at
+        ``max_len - 1``). Zero on dense engines (no block accounting) — and a
+        prefix-cache hit at admission can shrink the private share below this,
+        so it is the CONSERVATIVE demand the batcher gates on."""
+        if not self.paged:
+            return 0
+        need = min(int(prompt_len) + int(budget), self.max_len)
+        return -(-need // self._prefix_block_size)
+
+    def available_blocks(self) -> Optional[int]:
+        """Blocks an admission could allocate right now — the free list plus
+        every evictable cached chain; ``None`` on dense engines (unbounded).
+        The batcher gates admission and block-pressure preemption on this."""
+        if not self.paged:
+            return None
+        return self._allocator.available_blocks()
+
+    # transfers: kv-block
+    def _alloc_slot_blocks(self, slot: int, start: int, need: int) -> List[int]:
+        """Acquire ``need`` private pool blocks for ``slot``'s table columns
+        ``[start, start+need)``, flushing the in-flight burst once on shortfall
+        (its unreplayed retirements may be sitting on frees). Still short →
+        the structured pool-exhaustion failure: ``retryable``, because blocks
+        free as live requests retire. The grant is recorded in
+        ``_slot_block_map`` immediately, so every unwind path (cancel, the
+        admission orphan sweep) sees the ownership."""
+        if need <= 0:
+            self._slot_block_map.setdefault(slot, {})
+            return []
+        ids = self._allocator.alloc_blocks(need)
+        if ids is None:
+            if self._inflight is not None:
+                self._pending_events.extend(self._fetch_inflight())
+                ids = self._allocator.alloc_blocks(need)
+            if ids is None:
+                raise EngineFailure(
+                    f"KV block pool exhausted: need {need} block(s), "
+                    f"{self._allocator.available_blocks()} reclaimable of "
+                    f"{self._allocator.num_blocks}",
+                    reason="pool_exhausted", retryable=True,
+                )
+        self._slot_block_map[slot] = {start + i: b for i, b in enumerate(ids)}
+        if self._telemetry is not None:
+            self._telemetry.blocks_per_request.observe(float(need))
+            self._note_span(slot, "block_alloc", blocks=need, shared=start)
+            self._note_pool_gauges()
+        return ids
+
+    # owns: kv-block
+    def _free_slot_blocks(self, slot: int) -> None:
+        """Return ``slot``'s remaining private blocks to the allocator
+        (retire / cancel / quarantine / preempt leftovers — blocks the radix
+        index adopted already left the map). Safe mid-pipeline: see the
+        ordering note on ``_slot_block_map``."""
+        ids = self._slot_block_map.pop(slot, None)
+        if ids:
+            self._allocator.free_blocks(list(ids.values()))
+            if self._telemetry is not None:
+                self._note_pool_gauges()
+
+    def _note_pool_gauges(self) -> None:
+        """Refresh the pool-occupancy gauges (host counters only — no device
+        work; callers gate on ``self._telemetry is not None``)."""
+        stats = self._allocator.stats()
+        self._telemetry.pool_free_blocks.set(float(stats["free_blocks"]))
+        self._telemetry.pool_live_blocks.set(float(stats["slot_blocks"]))
+        self._telemetry.pool_cached_blocks.set(float(stats["cached_blocks"]))
+        self._telemetry.pool_pinned_blocks.set(float(stats["pinned_blocks"]))
+
+    def _write_slot_row(self, slot: int, block_ids: Sequence[int]) -> None:
+        """Upload one slot's block-table row: shared spliced prefix ids first,
+        then private ids; every unmapped tail column points at scratch, so the
+        row's masked writes always land somewhere harmless. One EXPLICIT
+        ``device_put`` plus a point-update dispatch (same admission-path
+        transfer discipline as ``_slot_device_update``); the in-flight step
+        keeps the OLD tables array, so this never disturbs a running burst."""
+        row = np.full((self._table_width,), self._scratch_block, dtype=np.int32)
+        row[: len(block_ids)] = block_ids
+        try:
+            self._tables = self._write_row_fn(
+                self._tables, *jax.device_put((np.int32(slot), row))
+            )
+        except Exception:
+            # the row write donates the tables: a failure here consumed them
+            self._device_poisoned = True
+            raise
 
     def _activate(self, slot: int, length: int, budget: int, temp: float, top_k: int, top_p: float) -> None:
         self._active[slot] = True
@@ -853,6 +1189,12 @@ class DecodeEngine:
                     # intact — only this call's own admissions roll back
                     for slot in list(self._admitting):
                         self.cancel(slot)
+                    if self.paged:
+                        # blocks granted to slots that never reached _activate
+                        # (a sibling's dispatch died mid-batch): sweep them
+                        for slot in list(self._slot_block_map):
+                            if not (self._active[slot] or self._reserved[slot]):
+                                self._free_slot_blocks(slot)
             raise
         finally:
             self._admitting = None
@@ -929,6 +1271,16 @@ class DecodeEngine:
                     prompt = slot_to_norm[slot][0]
                     padded[r, : prompt.size] = prompt
                     lengths[r] = prompt.size
+                if self.paged:
+                    # block admission: each slot's table row maps exactly its
+                    # lifetime demand; bucket padding past the allocation lands
+                    # on the row's scratch tail inside the paged insert
+                    for slot in chunk:
+                        norm = slot_to_norm[slot]
+                        private = self._alloc_slot_blocks(
+                            slot, 0, self.block_demand(norm[0].size, norm[1])
+                        )
+                        self._write_slot_row(slot, private)
                 if self._faults is not None:
                     self._faults.check_prefill()
                 local_cache, local_logits = self._prefill_fn(
@@ -1017,38 +1369,67 @@ class DecodeEngine:
             return False
         suffix_len = int(prompt.size) - matched
         bucket = self.bucket_for(suffix_len)
-        pad_len = matched + bucket  # exact: the suffix write never clamps
-        # hit-admission uploads are EXPLICIT device_puts: this is one of the two
-        # hot entry points the transfer-guard regression drives under
-        # disallow-implicit, so every host array states its transfer
-        block_ids = jax.device_put(
-            np.asarray([node.block_id for node in path], dtype=np.int32)
-        )
-        local_cache = self._restore_fn(self._pool, block_ids, pad_len)
-        self.prefix_restore_dispatches += 1
         ids = np.zeros((1, bucket), dtype=np.int32)
         ids[0, :suffix_len] = prompt[matched:]
-        try:
-            if self._faults is not None:
-                self._faults.check_prefill()
-            logits, local_cache = self._chunk_fn(
-                self._variables, jax.device_put(ids), local_cache,
-                jax.device_put(np.int32(matched)),
+        if self.paged:
+            # COPY-FREE restore: the matched blocks are already pool-resident,
+            # so the hit just splices their ids into the slot's table row and
+            # runs the suffix prefill over the gathered row — no copy-out
+            # dispatch at all (the restore counter still ticks: it now counts
+            # logical restores, and stays comparable with the dense engine)
+            try:
+                private = self._alloc_slot_blocks(
+                    slot, len(path), self.block_demand(prompt.size, budget) - len(path)
+                )
+                self._write_slot_row(slot, [node.block_id for node in path] + private)
+                self.prefix_restore_dispatches += 1
+                if self._faults is not None:
+                    self._faults.check_prefill()
+                logits = self._run_paged_chunk(ids, slot, matched)
+                self.prefill_dispatches += 1
+                self.prefill_tokens_computed += suffix_len
+                last = self._pick_last_fn(logits, jax.device_put(np.int32(suffix_len - 1)))
+                self._seal_slot(slot, int(prompt.size), last)
+            except Exception:
+                # release the matched-path references AND the private grant
+                # (a poisoning failure clears the allocator wholesale anyway;
+                # a clean one — pool_exhausted, injected prefill — must not
+                # strand either resource)
+                self.prefix_cache.release(path)
+                path.clear()
+                self._free_slot_blocks(slot)
+                raise
+        else:
+            pad_len = matched + bucket  # exact: the suffix write never clamps
+            # hit-admission uploads are EXPLICIT device_puts: this is one of the
+            # two hot entry points the transfer-guard regression drives under
+            # disallow-implicit, so every host array states its transfer
+            block_ids = jax.device_put(
+                np.asarray([node.block_id for node in path], dtype=np.int32)
             )
-            self.prefill_dispatches += 1
-            self.prefill_tokens_computed += suffix_len
-            last = self._pick_last_fn(logits, jax.device_put(np.int32(suffix_len - 1)))
-            self._insert_into_slots(
-                local_cache, last,
-                jax.device_put(np.asarray([slot], dtype=np.int32)),
-                jax.device_put(np.asarray([prompt.size], dtype=np.int32)),
-            )
-        except Exception:
-            # whatever died, this request's matched-path references must not
-            # leak with it (the blocks stay indexed for future hits)
-            self.prefix_cache.release(path)
-            path.clear()
-            raise
+            local_cache = self._restore_fn(self._pool, block_ids, pad_len)
+            self.prefix_restore_dispatches += 1
+            try:
+                if self._faults is not None:
+                    self._faults.check_prefill()
+                logits, local_cache = self._chunk_fn(
+                    self._variables, jax.device_put(ids), local_cache,
+                    jax.device_put(np.int32(matched)),
+                )
+                self.prefill_dispatches += 1
+                self.prefill_tokens_computed += suffix_len
+                last = self._pick_last_fn(logits, jax.device_put(np.int32(suffix_len - 1)))
+                self._insert_into_slots(
+                    local_cache, last,
+                    jax.device_put(np.asarray([slot], dtype=np.int32)),
+                    jax.device_put(np.asarray([prompt.size], dtype=np.int32)),
+                )
+            except Exception:
+                # whatever died, this request's matched-path references must not
+                # leak with it (the blocks stay indexed for future hits)
+                self.prefix_cache.release(path)
+                path.clear()
+                raise
         self.prefix_cache.record_hit(matched)
         self._activate(slot, int(prompt.size), budget, temp, top_k, top_p)
         self._slot_path[slot] = path
@@ -1058,6 +1439,33 @@ class DecodeEngine:
             self._note_span(slot, "prefix_hit", matched_tokens=matched, blocks=len(path))
             self._note_span(slot, "prefill", tokens=suffix_len, restored=matched)
         return True
+
+    def _run_paged_chunk(self, ids: np.ndarray, slot: int, position: int) -> Any:
+        """Dispatch one batch-1 prefill chunk straight into ``slot``'s pool
+        blocks (``_paged_chunk_fn``). The pool is DONATED: a dispatch failure
+        consumed the only KV storage, so it poisons the device state — unlike
+        the dense chunked path, a paged chunk death always escalates."""
+        try:
+            logits, self._pool = self._paged_chunk_fn(
+                self._variables, jax.device_put(ids), self._pool, self._tables,
+                *jax.device_put((np.int32(slot), np.int32(position))),
+            )
+        except Exception:
+            self._device_poisoned = True
+            raise
+        return logits
+
+    def _seal_slot(self, slot: int, length: int, last: Any) -> None:
+        """Point-update one table-resident prefill's length + sampling logits
+        (``_finish_slot_fn`` donates both vectors — failure poisons them)."""
+        try:
+            self._lens, self._last_logits = self._finish_slot_fn(
+                self._lens, self._last_logits,
+                *jax.device_put((np.int32(slot), np.int32(length))), last,
+            )
+        except Exception:
+            self._device_poisoned = True
+            raise
 
     def _index_prompt(self, slot: int, prompt: np.ndarray) -> None:
         """Start the slot's token transcript and (cache on) index the prompt's
@@ -1088,6 +1496,26 @@ class DecodeEngine:
             self._faults.note_observed("pool_exhausted")
             if path:
                 self._slot_path[slot] = path
+            return
+        if self.paged:
+            # ADOPTION, not a copy: the slot's own blocks already hold exactly
+            # the KV the tree wants, so indexing moves ownership slot → tree
+            # for each full block the tree lacks — zero device work. Where a
+            # sibling indexed the same block first, the existing node wins and
+            # the slot keeps (and later frees) its identical duplicate.
+            # ownership moves kv-block slot → radix tree via block_map pops
+            full, adopted = self.prefix_cache.adopt(
+                path, tokens, int(tokens.size) // self._prefix_block_size,
+                self._slot_block_map.setdefault(slot, {}),
+            )
+            if adopted:
+                # one logical save per adoption event: keeps the counter
+                # comparable with the dense engine's per-retirement save
+                self.prefix_save_dispatches += 1
+                if self._telemetry is not None:
+                    self._note_pool_gauges()
+            if full:
+                self._slot_path[slot] = full
             return
         # graftlint: disable=resource-leak -- the pool-rebuild return path drops 'full' deliberately: _rebuild_pool() forgets every cached prefix, so the refs die with the rebuilt cache
         full, new = self.prefix_cache.extend(
@@ -1166,7 +1594,28 @@ class DecodeEngine:
         padded_len = matched + -(-(prompt.size - matched) // chunk) * chunk
         if padded_len > self.max_len:
             return False
-        if matched:
+        if self.paged:
+            # no local workspace at all: allocate the slot's lifetime blocks,
+            # splice any matched prefix straight into the row, and let every
+            # chunk write through the table (``_run_paged_chunk``)
+            try:
+                private = self._alloc_slot_blocks(
+                    slot, len(path), self.block_demand(prompt.size, budget) - len(path)
+                )
+                self._write_slot_row(slot, [node.block_id for node in path] + private)
+            except Exception:
+                if path:
+                    self.prefix_cache.release(list(path))
+                self._free_slot_blocks(slot)
+                raise
+            local_cache = None
+            if matched:
+                self.prefix_restore_dispatches += 1  # copy-free splice
+                self.prefix_cache.record_hit(matched)
+                self._slot_path[slot] = list(path)
+                if self._telemetry is not None:
+                    self._note_span(slot, "prefix_hit", matched_tokens=matched, blocks=len(path))
+        elif matched:
             block_ids = jnp.asarray([node.block_id for node in path], dtype=jnp.int32)
             local_cache = self._restore_fn(self._pool, block_ids, padded_len)
             self.prefix_restore_dispatches += 1
@@ -1209,11 +1658,20 @@ class DecodeEngine:
             try:
                 if self._faults is not None:
                     self._faults.check_prefill()
-                logits, state["cache"] = self._chunk_fn(
-                    self._variables, jnp.asarray(ids), state["cache"],
-                    jnp.asarray(consumed, dtype=jnp.int32),
-                )
+                if self.paged:
+                    logits = self._run_paged_chunk(ids, slot, int(consumed))
+                else:
+                    logits, state["cache"] = self._chunk_fn(
+                        self._variables, jnp.asarray(ids), state["cache"],
+                        jnp.asarray(consumed, dtype=jnp.int32),
+                    )
             except Exception as exc:  # this slot's local dispatch: fail it alone
+                if self._device_poisoned:
+                    # paged chunks donate the POOL — the only KV storage — so
+                    # a REAL dispatch death cannot be contained to this slot;
+                    # injected prefill faults raise pre-dispatch (above) and
+                    # keep the per-slot isolation contract
+                    raise
                 rid = self._slot_rid.get(slot)
                 logger.warning(
                     "chunked prefill failed for slot %d: %s%s",
@@ -1236,11 +1694,16 @@ class DecodeEngine:
             last = self._pick_last_fn(
                 logits, jax.device_put(np.int32(prompt.size - 1 - consumed))
             )
-            self._insert_into_slots(
-                state["cache"], last,
-                jnp.asarray([slot], dtype=jnp.int32),
-                jnp.asarray([prompt.size], dtype=jnp.int32),
-            )
+            if self.paged:
+                # the KV is already pool-resident behind the slot's row: only
+                # the length + sampling logits need the point-update
+                self._seal_slot(slot, int(prompt.size), last)
+            else:
+                self._insert_into_slots(
+                    state["cache"], last,
+                    jnp.asarray([slot], dtype=jnp.int32),
+                    jnp.asarray([prompt.size], dtype=jnp.int32),
+                )
             del self._partials[slot]
             self._activate(
                 slot, prompt.size, state["budget"], state["temp"], state["top_k"], state["top_p"]
@@ -1255,6 +1718,8 @@ class DecodeEngine:
         self._reserved[slot] = False
         self._slot_queue_wait.pop(slot, None)
         self._release_prefix(slot)
+        if self.paged:
+            self._free_slot_blocks(slot)
         if self._telemetry is not None:
             self._drop_rid(slot)
         self._pending_events.append(
@@ -1262,15 +1727,22 @@ class DecodeEngine:
         )
 
     def _insert_into_slots(self, local_cache: Any, local_logits: Any, slots: Any, lengths: Any) -> None:
-        """Run the donating slot-insert dispatch. A failure here has CONSUMED
-        the shared engine cache/lens/logits, so it marks the device state
-        poisoned — the public entry point escalates to a full engine failure
-        instead of pretending the batch survived."""
+        """Run the donating slot-insert dispatch (paged: scatter the bucket
+        workspace through the admitted rows' block tables into the pool). A
+        failure here has CONSUMED the shared engine KV/lens/logits, so it marks
+        the device state poisoned — the public entry point escalates to a full
+        engine failure instead of pretending the batch survived."""
         try:
-            self._cache, self._lens, self._last_logits = self._insert_fn(
-                self._cache, self._lens, self._last_logits, local_cache, local_logits,
-                slots, lengths,
-            )
+            if self.paged:
+                self._pool, self._lens, self._last_logits = self._paged_insert_fn(
+                    self._pool, self._tables, self._lens, self._last_logits,
+                    local_cache, local_logits, slots, lengths,
+                )
+            else:
+                self._cache, self._lens, self._last_logits = self._insert_fn(
+                    self._cache, self._lens, self._last_logits, local_cache, local_logits,
+                    slots, lengths,
+                )
         except Exception:
             self._device_poisoned = True
             raise
@@ -1306,7 +1778,14 @@ class DecodeEngine:
         self._slot_top_k[:] = 0
         self._slot_top_p[:] = 1.0
         self._sync_sampling_mirrors()
-        if self.prefix_cache is not None:
+        if self.paged:
+            # the pool was reallocated above (_init_device_state): every block
+            # returns to the free list and the radix index forgets everything,
+            # held paths and pins included
+            self._allocator.clear()
+            self._slot_block_map.clear()
+            self._slot_path.clear()
+        elif self.prefix_cache is not None:
             # a full reset forgets every cached prefix too: the caller is
             # abandoning everything, held paths included
             self._rebuild_pool()
@@ -1371,9 +1850,17 @@ class DecodeEngine:
     def _capture_salvage(self) -> None:
         """Snapshot every active/reserved slot's resumable state — HOST data
         only (the device may be poisoned): the replayed transcript, the
-        unspent budget, and whatever radix path the slot already held, pinned
-        so the blocks survive the rebuild and LRU until the resume."""
+        unspent budget, and (dense engines) whatever radix path the slot
+        already held, pinned so the blocks survive the rebuild and LRU until
+        the resume. PAGED engines salvage transcripts only: the pool itself
+        rides the failed step's donation, so no block outlives the rebuild."""
         self.discard_salvage()  # a prior incident's uncollected records
+        if self.paged:
+            # return every slot-owned block NOW (host-side accounting): the
+            # rebuild also clears the allocator, but if the rebuild itself
+            # fails the engine must still not report leaked slot blocks
+            for blk_slot in list(self._slot_block_map):
+                self._free_slot_blocks(blk_slot)
         records: List[SalvagedSlot] = []
         for slot in np.flatnonzero(self._active | self._reserved):
             slot = int(slot)
@@ -1389,7 +1876,15 @@ class DecodeEngine:
                 tokens = [int(t) for t in transcript[:valid]]
                 remaining = int(self._remaining[slot])
             path = self._slot_path.pop(slot, [])
-            if path and self.prefix_cache is not None and tokens and remaining > 0:
+            if self.paged:
+                # the failed step consumed the POOL — the only KV storage — so
+                # no block survives the rebuild: paged salvage is TRANSCRIPT-
+                # only (release the refs; the rebuild clears the tree anyway)
+                # and the resume pays a full re-prefill instead of a suffix
+                if path and self.prefix_cache is not None:
+                    self.prefix_cache.release(path)
+                path = []
+            elif path and self.prefix_cache is not None and tokens and remaining > 0:
                 self.prefix_cache.pin(path)
                 self.prefix_cache.release(path)  # the slot's own working refs
             else:
@@ -1423,10 +1918,13 @@ class DecodeEngine:
     def rebuild(self, *, resume: bool = True) -> None:  # graftlint: off-path (error recovery, not steady-state decode)
         """Reallocate the engine's device state from host-retained params.
 
-        Unlike :meth:`reset`, the prefix-cache pool and radix index SURVIVE
-        (block saves donate only the pool, and their failures rebuild it
-        locally — see ``_extend_index``), so salvaged requests re-admit
-        through the ordinary prefix-hit path and pay only a suffix prefill.
+        On DENSE engines — unlike :meth:`reset` — the prefix-cache pool and
+        radix index SURVIVE (block saves donate only the pool, and their
+        failures rebuild it locally — see ``_extend_index``), so salvaged
+        requests re-admit through the ordinary prefix-hit path and pay only a
+        suffix prefill. On PAGED engines the pool IS the decode state and rode
+        the failed step's donation, so the rebuild restarts the allocator and
+        index empty and salvaged requests re-prefill in full.
 
         ``resume=True`` (supervised recovery) reconstructs the PRNG key by
         replaying the recorded number of key-consuming steps from the seeded
@@ -1461,6 +1959,12 @@ class DecodeEngine:
             self._release_prefix(slot)  # salvage holds its own pins by now
         self._slot_tokens.clear()
         self._init_device_state()
+        if self.paged:
+            # the failed step consumed the pool itself; the reallocation above
+            # emptied it, so the allocator and radix index restart from scratch
+            # (salvage is transcript-only in paged mode for exactly this reason)
+            self._allocator.clear()
+            self._slot_block_map.clear()
         self._sync_sampling_mirrors()
         if resume and self._key_steps:
             # replay the consumed key advances (one split per any-active step)
@@ -1497,8 +2001,13 @@ class DecodeEngine:
         if finished:
             self._active[slot] = False
             if self.prefix_cache is not None and self.prefix_cache_generated:
-                self._capture_generated(slot)
+                self._capture_generated(slot)  # paged: adopts blocks in place
             self._release_prefix(slot)
+            if self.paged:
+                # whatever the index did not adopt (partial tail, unused
+                # budget) goes back to the free list right now — safe even
+                # with a burst in flight (see _slot_block_map's ordering note)
+                self._free_slot_blocks(slot)
             if self._telemetry is not None:
                 self._drop_rid(slot)
         return StepEvent(
@@ -1700,6 +2209,10 @@ class DecodeEngine:
         self._slot_top_p[slot] = 1.0
         self._slot_queue_wait.pop(slot, None)
         self._release_prefix(slot)  # no generated-KV capture: it may be poisoned
+        if self.paged:
+            # NaN-poisoned block CONTENT is harmless once re-owned: the next
+            # owner's prefill overwrites every position before reading it
+            self._free_slot_blocks(slot)
         self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
         if self._inflight is not None:
             # the already-dispatched next burst still decodes this slot under
@@ -1804,21 +2317,43 @@ class DecodeEngine:
                 # injected dispatch failures take the SAME except path a real
                 # device error takes (nothing below special-cases injection)
                 self._faults.check_step_dispatch()
-            (
-                self._cache,
-                self._last_logits,
-                self._lens,
-                self._active_dev,
-                self._remaining_dev,
-                self._key,
-                tokens,
-                masks,
-                bads,
-            ) = fn(
-                self._variables, self._cache, self._last_logits, self._lens,
-                self._active_dev, self._remaining_dev, self._key,
-                self._temp_dev, self._top_k_dev, self._top_p_dev,
-            )
+            if self.paged:
+                # the pool rides the dispatch donated (argnums pin it); the
+                # TABLES ride as a non-donated input — they only change at
+                # admission, between dispatches, so the burst reads one
+                # consistent map for its whole scan
+                # graftlint: disable=use-after-donate -- paged _make_step donates argnums (1, 3): the pool and last_logits; self._tables at position 2 is a plain input (the dense maker's (1, 2) map does not apply to this call)
+                (
+                    self._pool,
+                    self._last_logits,
+                    self._lens,
+                    self._active_dev,
+                    self._remaining_dev,
+                    self._key,
+                    tokens,
+                    masks,
+                    bads,
+                ) = fn(
+                    self._variables, self._pool, self._tables, self._last_logits,
+                    self._lens, self._active_dev, self._remaining_dev, self._key,
+                    self._temp_dev, self._top_k_dev, self._top_p_dev,
+                )
+            else:
+                (
+                    self._cache,
+                    self._last_logits,
+                    self._lens,
+                    self._active_dev,
+                    self._remaining_dev,
+                    self._key,
+                    tokens,
+                    masks,
+                    bads,
+                ) = fn(
+                    self._variables, self._cache, self._last_logits, self._lens,
+                    self._active_dev, self._remaining_dev, self._key,
+                    self._temp_dev, self._top_k_dev, self._top_p_dev,
+                )
         except Exception:
             self._on_failure()
             raise
@@ -1871,6 +2406,9 @@ class DecodeEngine:
         self._partials.clear()
         for slot in list(self._slot_path):
             self._release_prefix(slot)
+        if self.paged:
+            for slot in list(self._slot_block_map):
+                self._free_slot_blocks(slot)
         self._slot_tokens.clear()
         self._slot_queue_wait.clear()
         self._slot_rid.clear()
@@ -1906,6 +2444,8 @@ class DecodeEngine:
         if self._telemetry is not None:
             self._drop_rid(slot)
         self._release_prefix(slot)
+        if self.paged:
+            self._free_slot_blocks(slot)  # pipeline flushed above: nothing reads them
         self._slot_device_update(slot, False, 0, self.temperature, 0, 1.0)
 
     # transfers: kv-pin
@@ -1914,9 +2454,11 @@ class DecodeEngine:
 
         The preempt-to-prefix-cache primitive the SLO scheduler drives: the
         slot's full transcript (prompt + generated tokens) is indexed into the
-        radix tree block-by-block — device-copying KV only for blocks the tree
-        does not already hold — and the resulting node path is PINNED against
-        LRU eviction. The slot then deactivates exactly like :meth:`cancel`
+        radix tree block-by-block — paged engines ADOPT the slot's own pool
+        blocks in place (the checkpoint is pure ownership bookkeeping: no
+        re-slicing, no device copy); dense engines device-copy KV only for
+        blocks the tree does not already hold — and the resulting node path is
+        PINNED against LRU eviction. The slot then deactivates exactly like :meth:`cancel`
         (pipeline flushed first, so the transcript and the delivered token
         stream agree), and the returned :class:`PreemptedSlot` lets the caller
         re-queue the request: re-admitting ``tokens`` as the prompt restores
@@ -1966,6 +2508,12 @@ class DecodeEngine:
         try:
             self.prefix_cache.release(path)
             self._slot_tokens.pop(slot, None)
+            if self.paged:
+                # NEAR-FREE handoff: the checkpoint's blocks were ADOPTED by
+                # the index inside _extend_index above — ownership moved, no
+                # dense re-slicing, no device copy. Only the un-adopted
+                # leftovers (partial tail, unused budget) return to the pool.
+                self._free_slot_blocks(slot)
             self._active[slot] = False
             self._reserved[slot] = False
             self._remaining[slot] = 0
@@ -2443,8 +2991,9 @@ class ContinuousBatcher:
             self.scheduler.config.fifo
             or not self.scheduler.config.preempt
             or self._engine.prefix_cache is None
-            or self._engine.free_slots
         ):
+            return
+        if self._engine.free_slots and not self._block_starved():
             return
         waiting = self.scheduler.best_waiting_priority()
         if waiting is None:
@@ -2501,6 +3050,20 @@ class ContinuousBatcher:
                 raise
             return
 
+    def _block_starved(self) -> bool:
+        """True when the head queued ticket's conservative block demand
+        exceeds what the paged pool could allocate right now — the signal
+        that block pressure (not slot scarcity) is gating admission, which
+        arms preempt-to-prefix-cache even with slots free. Always False on
+        dense engines (no block accounting)."""
+        avail = getattr(self._engine, "available_blocks", lambda: None)()
+        if avail is None:
+            return False
+        head = self.scheduler.peek()
+        if head is None:
+            return False
+        return self._engine.block_demand(len(head.prompt), head.budget) > avail
+
     def _admit(self) -> None:  # graftlint: off-path (admission, not steady-state decode)
         self._drain_orphans()
         self._enforce_deadlines()
@@ -2513,7 +3076,16 @@ class ContinuousBatcher:
             if not batch:
                 return
             admissible = []
+            blocked: List[Any] = []
+            # paged admission gates on BLOCK demand too: tickets past the
+            # pool's reclaimable budget requeue (in scheduler order) instead
+            # of bouncing off the engine's pool_exhausted failure — they age
+            # in the queue and admit as running requests retire
+            avail = getattr(self._engine, "available_blocks", lambda: None)()
             for ticket in batch:
+                if blocked:
+                    blocked.append(ticket)  # keep scheduler order behind the blocker
+                    continue
                 if ticket.sink.cancelled:  # consumer gave up while queued
                     self._release_ticket(ticket)
                     self._tel_end(ticket, "cancelled")
@@ -2525,11 +3097,23 @@ class ContinuousBatcher:
                     self._tel_end(ticket, "error", "invalid_request")
                     self._deliver(ticket.sink, "fail", exc)
                     continue
+                if avail is not None:
+                    demand = self._engine.block_demand(len(ticket.prompt), ticket.budget)
+                    if demand > avail:
+                        # head-of-line blocking on purpose: admitting smaller
+                        # latecomers around a starved head would starve it
+                        blocked.append(ticket)
+                        continue
+                    avail -= demand
                 admissible.append(ticket)
+            for ticket in blocked:
+                self.scheduler.requeue(ticket, preemption=False)
+            if admissible and not self._admit_batch(admissible):
+                return  # engine failure ended this admission round
+            if blocked:
+                return  # the pool is the binding constraint: wait for retirements
             if not admissible:
                 continue
-            if not self._admit_batch(admissible):
-                return  # engine failure ended this admission round
 
     def _drain_flush_events(self) -> None:
         """Deliver events an admission-time pipeline flush buffered — under

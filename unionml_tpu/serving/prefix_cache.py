@@ -23,6 +23,15 @@ Design (the vLLM/SGLang radix-cache discipline, block-granular):
 The tree is pure host Python (no jax import): the engine owns the device pool
 and performs the gather/scatter copies; this index only decides WHICH blocks
 hold WHAT tokens and WHEN a block may be reused.
+
+Paged serving (PR 13) widened this class from *index* to *allocator*: live
+decode slots now draw their working blocks from the same pool through
+:meth:`alloc_blocks`/:meth:`free_blocks`, and a retiring slot's full blocks are
+indexed copy-free by :meth:`adopt` — the tree node takes ownership of the
+slot's block instead of allocating a fresh one and device-copying KV into it.
+Every pool block is therefore owned by exactly one of: the free list, a tree
+node, or a live slot (``slot_blocks`` counts the last), which is what makes
+"zero leaked or double-freed blocks" a teardown counter check.
 """
 
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -124,11 +133,17 @@ class PrefixCache:
         self.inserted_blocks = 0
         self.evicted_blocks = 0
         self.pinned_blocks = 0
+        #: blocks currently owned by live decode slots (paged serving); the
+        #: engine acquires them via alloc_blocks and returns them via
+        #: free_blocks or adopt — teardown asserts this is back to zero
+        self.slot_blocks = 0
+        self.adopted_blocks = 0
 
     @property
     def cached_blocks(self) -> int:
-        """Pool blocks currently holding indexed KV."""
-        return self.num_blocks - len(self._free)
+        """Pool blocks currently holding indexed KV (tree-owned: excludes both
+        the free list and live slots' working blocks)."""
+        return self.num_blocks - len(self._free) - self.slot_blocks
 
     def _key_at(self, tokens: Sequence[int], block_index: int) -> Tuple[int, ...]:
         return block_key(tokens, block_index, self.block_size)
@@ -210,6 +225,96 @@ class PrefixCache:
             node = child
         return full, new
 
+    def alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """Acquire ``n`` pool blocks for a live slot's working set (paged
+        admission), evicting LRU unreferenced leaves as needed. All-or-nothing:
+        returns ``None`` — with nothing allocated — if fewer than ``n`` blocks
+        can be freed, so a failed admission never strands a partial grant.
+        The caller owns the returned ids until :meth:`free_blocks` or
+        :meth:`adopt` hands each one back."""
+        ids: List[int] = []
+        for _ in range(n):
+            block_id = self._alloc()
+            if block_id is None:
+                self._free.extend(reversed(ids))  # rollback, preserving order
+                return None
+            ids.append(block_id)
+        self.slot_blocks += n
+        return ids
+
+    def free_blocks(self, ids: Sequence[int]) -> None:
+        """Return slot-owned blocks (from :meth:`alloc_blocks`) to the free
+        list — the paged engine calls this when a slot retires with blocks the
+        radix index did not :meth:`adopt` (partial tail, unused budget)."""
+        self._free.extend(int(b) for b in ids)
+        self.slot_blocks -= len(ids)
+        assert self.slot_blocks >= 0, "freed more slot blocks than were allocated"
+
+    def available_blocks(self) -> int:
+        """Blocks an :meth:`alloc_blocks` call could acquire right now: the
+        free list plus every evictable (transitively unreferenced) tree chain.
+        Admission gates block demand on this without mutating the tree."""
+        def reclaim(node: _Node) -> Tuple[int, bool]:
+            # (reclaimable blocks in the subtree, whole subtree evictable?):
+            # leaves-only eviction frees a node iff all its descendants go
+            # first, but a referenced parent doesn't shield evictable leaf
+            # chains below it. Depth is bounded by max_len/block_size.
+            count, fully = 0, True
+            for child in node.children.values():
+                sub, sub_fully = reclaim(child)
+                count += sub
+                fully = fully and sub_fully
+            if fully and node.refcount <= 0:
+                return count + 1, True
+            return count, False
+
+        total = 0
+        for child in self._root.children.values():
+            total += reclaim(child)[0]
+        return len(self._free) + total
+
+    def adopt(
+        self,
+        path: List[_Node],
+        tokens: Sequence[int],
+        max_blocks: int,
+        block_map: Dict[int, int],
+    ) -> Tuple[List[_Node], int]:
+        """Copy-free :meth:`extend`: index ``tokens``' full blocks beyond
+        ``path`` by transferring ownership of the caller's own pool blocks.
+
+        ``block_map`` maps block index -> the slot-owned block id already
+        holding that block's KV (the slot's table wrote it there during
+        decode). A missing tree node ADOPTS the mapped block — the id is popped
+        from ``block_map`` and ownership moves slot -> tree, no device copy.
+        Where a sibling indexed the same block first, the existing node is
+        acquired and the slot keeps (and later frees) its duplicate. Returns
+        ``(full_path, adopted)``; every node of ``full_path`` holds a reference
+        the caller must eventually :meth:`release`.
+        """
+        self._tick += 1
+        node = path[-1] if path else self._root
+        full = list(path)
+        adopted = 0
+        while len(full) < max_blocks:
+            key = self._key_at(tokens, len(full))
+            child = node.children.get(key)
+            if child is None:
+                block_id = block_map.pop(len(full), None)
+                if block_id is None:  # caller has no block for this index
+                    break
+                child = _Node(key, block_id, node)
+                node.children[key] = child
+                adopted += 1
+                self.inserted_blocks += 1
+                self.adopted_blocks += 1
+                self.slot_blocks -= 1  # ownership: slot -> tree
+            child.last_used = self._tick
+            child.refcount += 1
+            full.append(child)
+            node = child
+        return full, adopted
+
     def release(self, path: Sequence[_Node]) -> None:
         """Drop one reference from every node of ``path`` (slot retirement)."""
         for node in path:
@@ -241,10 +346,13 @@ class PrefixCache:
         self.pinned_blocks = max(0, self.pinned_blocks - len(path))
 
     def clear(self) -> None:
-        """Forget every cached block (engine reset: the pool is reallocated)."""
+        """Forget every cached block (engine reset: the pool is reallocated).
+        Slot-owned blocks are reclaimed too — the paged engine only calls this
+        when every slot's device state is being rebuilt with it."""
         self._root = _Node((), -1, None)
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self.pinned_blocks = 0
+        self.slot_blocks = 0
 
     def _alloc(self) -> Optional[int]:
         if self._free:
@@ -284,4 +392,9 @@ class PrefixCache:
             "inserted_blocks": self.inserted_blocks,
             "evicted_blocks": self.evicted_blocks,
             "pinned_blocks": self.pinned_blocks,
+            # paged-pool occupancy: live working blocks, free headroom, and
+            # copy-free index adoptions (all zero on a dense-mode engine)
+            "slot_blocks": self.slot_blocks,
+            "free_blocks": len(self._free),
+            "adopted_blocks": self.adopted_blocks,
         }

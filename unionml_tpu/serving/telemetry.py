@@ -236,6 +236,26 @@ class Telemetry:
         self.faults_injected_total = m.counter(
             "unionml_faults_injected_total", "Faults injected by the active FaultPlan", ("site",)
         )
+        # paged KV pool occupancy (ISSUE 13): every block is owned by exactly
+        # one of free list / live slot / radix index, so these three gauges
+        # plus pinned (a subset of cached) give capacity headroom at a glance
+        self.pool_free_blocks = m.gauge(
+            "unionml_kv_pool_free_blocks", "Paged KV pool blocks on the free list"
+        )
+        self.pool_live_blocks = m.gauge(
+            "unionml_kv_pool_live_blocks", "Paged KV pool blocks owned by live decode slots"
+        )
+        self.pool_cached_blocks = m.gauge(
+            "unionml_kv_pool_cached_blocks", "Paged KV pool blocks held by the radix prefix index"
+        )
+        self.pool_pinned_blocks = m.gauge(
+            "unionml_kv_pool_pinned_blocks", "Paged KV pool blocks pinned by preempt/salvage checkpoints"
+        )
+        self.blocks_per_request = m.histogram(
+            "unionml_kv_blocks_per_request",
+            "Pool blocks allocated per admitted request (paged engines)",
+            log_buckets(1.0, 2.0, 12),
+        )
 
     # ------------------------------------------------------------------ traces
 
