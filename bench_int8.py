@@ -1,4 +1,4 @@
-"""int8 weight-only decode at scale: does it pay at ~1B params?
+"""int8 decode at scale: does it pay at ~1B params?
 
 The round-2 lookahead probe found int8 neutral-to-slightly-slower at GPT-2
 small (124M): dequant overhead ~= weight-traffic savings
@@ -6,8 +6,12 @@ small (124M): dequant overhead ~= weight-traffic savings
 weight-bound — >=1B params — has never been measured. This harness builds a
 ~1.3B-param randomly-initialized GPT (weight TRAFFIC is what decode time
 measures; weight values are irrelevant), runs the continuous engine's
-single-stream decode with and without ``quantize="int8"``, and records
-tokens/s for both into ``INT8_BENCH.json``.
+single-stream decode with and without ``quantize="int8"``, plus the PR-14
+combined arm (int8 weights over an int8 paged KV pool, ``kv_quantize``),
+and records tokens/s and resident bytes for all three into
+``INT8_BENCH.json``. Byte accounting reuses the ops.quant helpers
+(``quantized_bytes``) and the engine's ``kv_pool_stats()`` — the same
+numbers the serving telemetry gauges export.
 
 Run by tools/tpu_window.sh last (it is the battery's most expensive phase).
 CPU smoke uses the tiny config so the harness itself stays testable.
@@ -63,18 +67,28 @@ def run():
     print(f"[int8] init {n_params/1e9:.2f}B params in {time.monotonic() - t0:.0f}s", file=sys.stderr)
     deadline = time.monotonic() + TOTAL_BUDGET_S
 
+    from unionml_tpu.ops.quant import quantized_bytes
+
     prompt = [3, 1, 4, 1, 5]
     results = {"params_b": round(n_params / 1e9, 3), "max_new_tokens": max_new,
                "lookahead": lookahead}
-    for mode in (None, "int8"):
-        name = mode or "bf16"
+    MAX_LEN, BS = 128, 4
+    arms = (
+        ("bf16", {}),
+        ("int8", {"quantize": "int8"}),
+        # the PR-14 serving config: int8 weights AND an int8 paged KV pool
+        ("int8_kv8", {"quantize": "int8", "paged": True,
+                      "pool_blocks": MAX_LEN // BS + 1, "prefix_block_size": BS,
+                      "prefix_cache_blocks": 0, "kv_quantize": "int8"}),
+    )
+    for name, extra in arms:
         if time.monotonic() > deadline:
             results[name] = {"error": "budget exhausted"}
             continue
         try:
             engine = DecodeEngine(
-                model, variables, num_slots=1, max_len=128, prefill_buckets=(8,),
-                quantize=mode,
+                model, variables, num_slots=1, max_len=MAX_LEN, prefill_buckets=(8,),
+                **extra,
             )
             # warm: one full completion compiles prefill + decode
             engine.generate(prompt, max_new, lookahead=lookahead)
@@ -85,14 +99,24 @@ def run():
             elapsed = time.perf_counter() - t1
             tok_s = reps * len(tokens) / elapsed
             results[name] = {"tokens_per_s": round(tok_s, 1), "reps": reps}
+            if extra.get("quantize"):
+                stored, full = quantized_bytes(engine._variables)
+                results[name]["weight_bytes_stored"] = int(stored)
+                results[name]["weight_bytes_dense_equiv"] = int(full)
+            kv = engine.kv_pool_stats()
+            if kv:
+                results[name]["kv_dtype"] = kv["kv_dtype"]
+                results[name]["kv_pool_bytes"] = kv["kv_pool_bytes"]
+                results[name]["kv_pool_bytes_dense_equiv"] = kv["kv_pool_bytes_dense_equiv"]
             print(f"[int8] {name}: {tok_s:.1f} tok/s", file=sys.stderr)
         except Exception as exc:
             results[name] = {"error": f"{type(exc).__name__}: {exc}"}
             print(f"[int8] {name} failed: {exc}", file=sys.stderr)
-    if "tokens_per_s" in results.get("bf16", {}) and "tokens_per_s" in results.get("int8", {}):
-        results["int8_speedup"] = round(
-            results["int8"]["tokens_per_s"] / results["bf16"]["tokens_per_s"], 3
-        )
+    for name in ("int8", "int8_kv8"):
+        if "tokens_per_s" in results.get("bf16", {}) and "tokens_per_s" in results.get(name, {}):
+            results[f"{name}_speedup"] = round(
+                results[name]["tokens_per_s"] / results["bf16"]["tokens_per_s"], 3
+            )
     return results
 
 

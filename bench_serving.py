@@ -787,6 +787,163 @@ def bench_paged(modes=("on", "off"), n_requests: int = 16, prompt_len: int = 6,
     return out
 
 
+def bench_int8_kv(modes=("on", "off"), n_requests: int = 16, prompt_len: int = 6,
+                  max_new_tokens: int = 24, mesh_devices: int = 0):
+    """int8-vs-bf16 KV POOL A/B at EQUAL pool byte budget
+    (``bench_serving.py --int8 {on,off,ab}``).
+
+    Both arms are paged and get the SAME pool bytes: the bf16 arm keeps the
+    PR-11 geometry (65 four-token blocks behind block tables), the int8 arm
+    converts that byte budget into int8 blocks via ``gpt.kv_block_bytes`` —
+    int8 payload + per-(block, head) f32 scales per block, so the same HBM
+    holds ~2x the cached positions (~3.8x on the f32 CPU harness). Reported
+    per arm: measured PEAK concurrency under block-gated admission, decode
+    tok/s, and the pool's stored-vs-dense-equivalent bytes from
+    ``kv_pool_stats()``. The ``ab`` mode gates BOTH halves of the tentpole
+    claim in one run: int8 must fit >= 1.8x the concurrent requests at equal
+    bytes AND a greedy logit probe (pipeline=False engines, per-step
+    ``_last_logits``) must stay within the pinned quality budgets
+    ``KV_INT8_LOGPROB_DELTA_BUDGET`` / ``KV_INT8_GREEDY_DIVERGENCE_BUDGET``,
+    else the battery step fails."""
+    from unionml_tpu.models.gpt import kv_block_bytes
+    from unionml_tpu.ops.quant import (
+        KV_INT8_GREEDY_DIVERGENCE_BUDGET,
+        KV_INT8_LOGPROB_DELTA_BUDGET,
+    )
+    from unionml_tpu.serving.continuous import DecodeEngine
+
+    config, model, variables = _bench_gpt()
+    mesh = _serving_mesh(mesh_devices, config.num_heads) if mesh_devices else None
+
+    BS, MAX_LEN, KV_TOKENS = 4, 64, 256
+    dense_blocks = KV_TOKENS // BS + 1  # PR-11 pool: 64 usable + scratch
+    bytes_dense = kv_block_bytes(config, BS)
+    bytes_int8 = kv_block_bytes(config, BS, kv_quantize="int8")
+    pool_byte_budget = dense_blocks * bytes_dense
+    int8_blocks = pool_byte_budget // bytes_int8  # same bytes, more blocks
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, config.vocab_size, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def run(int8: bool):
+        engine = DecodeEngine(
+            model, variables, num_slots=16, max_len=MAX_LEN,
+            prefill_buckets=(8,), mesh=mesh, paged=True,
+            pool_blocks=int8_blocks if int8 else dense_blocks,
+            prefix_block_size=BS, prefix_cache_blocks=0,
+            kv_quantize="int8" if int8 else None,
+        )
+        engine.generate(prompts[0], 4)  # warm the prefill/decode programs
+        base_tokens = engine.tokens_decoded
+        pending = list(prompts)
+        peak = 0
+        with _RetraceCounter() as retraces:
+            t0 = time.perf_counter()
+            while pending or engine.num_active or engine.has_pending_events:
+                while pending and engine.free_slots:
+                    avail = engine.available_blocks()
+                    if (avail is not None
+                            and engine.block_demand(len(pending[0]), max_new_tokens) > avail):
+                        break  # block-gated (the batcher's admission rule)
+                    engine.admit_many([(pending.pop(0), max_new_tokens)])
+                peak = max(peak, engine.num_active)
+                engine.step()
+            elapsed = time.perf_counter() - t0
+        decoded = engine.tokens_decoded - base_tokens
+        stats = engine.kv_pool_stats()
+        return {
+            "decode_tok_s": round(decoded / elapsed, 1),
+            "total_s": round(elapsed, 4),
+            "tokens": decoded,
+            "retraces": retraces.count,
+            "peak_concurrent": peak,
+            "pool_blocks": int8_blocks if int8 else dense_blocks,
+            "kv_dtype": stats["kv_dtype"],
+            "kv_pool_bytes": stats["kv_pool_bytes"],
+            "kv_pool_bytes_dense_equiv": stats["kv_pool_bytes_dense_equiv"],
+        }
+
+    def logsoftmax(x):
+        x = x - x.max()
+        return x - np.log(np.exp(x).sum())
+
+    def greedy_trace(engine, prompt, n):
+        # pipeline=False keeps _last_logits as "the logits token t samples from"
+        slot = engine.add_request(list(prompt), n)
+        toks, logits = [], []
+        for _ in range(n):
+            logits.append(np.asarray(engine._last_logits)[slot].copy())
+            toks.extend(ev.token for ev in engine.step() if ev.emit and ev.slot == slot)
+        while engine.busy or engine._inflight is not None or engine.has_pending_events:
+            engine.step()
+        return toks, logits
+
+    def quality_probe():
+        """The pinned quality gate, run against the SAME budgets the unit
+        tests pin: greedy-divergence rate and pre-divergence logprob delta
+        of the int8 pool vs the bf16 pool."""
+        kw = dict(num_slots=4, max_len=MAX_LEN, prefill_buckets=(8,), mesh=mesh,
+                  paged=True, pool_blocks=dense_blocks, prefix_block_size=BS,
+                  prefix_cache_blocks=0, pipeline=False, prefill_chunk=None)
+        ref = DecodeEngine(model, variables, **kw)
+        quant = DecodeEngine(model, variables, kv_quantize="int8", **kw)
+        probe_rng = np.random.default_rng(1)
+        probes = [probe_rng.integers(1, config.vocab_size, size=8).tolist()
+                  for _ in range(3)]
+        total = diverged = 0
+        max_delta = 0.0
+        for prompt in probes:
+            t_ref, l_ref = greedy_trace(ref, prompt, 16)
+            t_q, l_q = greedy_trace(quant, prompt, 16)
+            m = min(len(t_ref), len(t_q))
+            first = next((i for i in range(m) if t_ref[i] != t_q[i]), m)
+            total += m
+            diverged += m - first
+            for i in range(first):  # only the common prefix is comparable
+                delta = abs(logsoftmax(l_ref[i])[t_ref[i]] - logsoftmax(l_q[i])[t_ref[i]])
+                max_delta = max(max_delta, float(delta))
+        rate = diverged / max(total, 1)
+        return {
+            "probe_tokens": total,
+            "divergence_rate": round(rate, 4),
+            "divergence_budget": KV_INT8_GREEDY_DIVERGENCE_BUDGET,
+            "max_logprob_delta": round(max_delta, 4),
+            "logprob_delta_budget": KV_INT8_LOGPROB_DELTA_BUDGET,
+            "quality_ok": bool(
+                total > 0
+                and rate <= KV_INT8_GREEDY_DIVERGENCE_BUDGET
+                and max_delta <= KV_INT8_LOGPROB_DELTA_BUDGET
+            ),
+        }
+
+    out = {
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "request_kv_footprint": prompt_len + max_new_tokens,
+        "mesh_devices": mesh_devices or 1,
+        "pool_byte_budget": pool_byte_budget,
+        "kv_block_bytes_dense": bytes_dense,
+        "kv_block_bytes_int8": bytes_int8,
+        "blocks_per_byte_ratio": round(bytes_dense / bytes_int8, 3),
+    }
+    for mode in modes:
+        out["int8_" + mode] = run(mode == "on")
+    if "int8_on" in out and "int8_off" in out:
+        out["concurrency_ratio"] = round(
+            out["int8_on"]["peak_concurrent"]
+            / max(out["int8_off"]["peak_concurrent"], 1), 3
+        )
+        out["speedup_tok_s"] = round(
+            out["int8_on"]["decode_tok_s"]
+            / max(out["int8_off"]["decode_tok_s"], 1e-9), 3
+        )
+        out["quality"] = quality_probe()
+    return out
+
+
 def bench_obs(modes=("on", "off"), n_requests: int = 16, max_new_tokens: int = 32,
               repeats: int = 3, mesh_devices: int = 0):
     """Telemetry ON-vs-OFF A/B: the same concurrent request mix through the
@@ -1343,6 +1500,16 @@ def main():
                         "streams, else exits nonzero). Runs ONLY this phase "
                         "(like --pipeline); combine with --mesh N for the "
                         "head-sharded pool")
+    parser.add_argument("--int8", choices=("on", "off", "ab"), default=None,
+                        help="focused int8-KV-pool phase: peak concurrent requests "
+                        "+ decode tok/s at EQUAL pool byte budget (int8 blocks + "
+                        "f32 scales vs the bf16 paged pool), plus the pinned "
+                        "quality probe ('ab' runs the pair and GATES: int8 must "
+                        "fit >= 1.8x the concurrent requests AND stay within the "
+                        "KV_INT8_* logprob-delta/divergence budgets in the same "
+                        "run, else exits nonzero). Runs ONLY this phase (like "
+                        "--paged); combine with --mesh N for the head-sharded "
+                        "pool + scales")
     parser.add_argument(
         "--out",
         default="SERVING_BENCH.json",
@@ -1358,7 +1525,7 @@ def main():
 
     backend = jax.default_backend()
     if (args.pipeline or args.mesh or args.slo_mix or args.chaos or args.fleet
-            or args.obs or args.paged):
+            or args.obs or args.paged or args.int8):
         import os
 
         base, ext = os.path.splitext(args.out)
@@ -1366,6 +1533,8 @@ def main():
             base = f"{base}_pipeline"
         if args.paged:
             base = f"{base}_paged"
+        if args.int8:
+            base = f"{base}_int8"
         if args.obs:
             base = f"{base}_obs"
         if args.slo_mix:
@@ -1528,6 +1697,41 @@ def main():
         # pack >= 1.5x the concurrent requests without changing a single token
         if len(modes) == 2 and not (
             ab["concurrency_ratio"] >= 1.5 and ab["token_identical"]
+        ):
+            return 1
+        return 0
+
+    if args.int8:
+        if args.mesh and len(jax.devices()) < args.mesh:
+            print(json.dumps({"metric": "int8_peak_concurrent",
+                              "error": f"--mesh {args.mesh} needs {args.mesh} devices, "
+                              f"found {len(jax.devices())}", "backend": backend}))
+            return 1
+        modes = ("on", "off") if args.int8 == "ab" else (args.int8,)
+        ab = bench_int8_kv(modes=modes, mesh_devices=args.mesh)
+        results["models"]["int8_ab" if len(modes) == 2 else f"int8_{modes[0]}"] = ab
+        line = {"metric": "int8_peak_concurrent", "backend": backend,
+                "mesh_devices": args.mesh or 1,
+                "pool_byte_budget": ab["pool_byte_budget"]}
+        for mode in modes:
+            line[f"peak_concurrent_{mode}"] = ab[f"int8_{mode}"]["peak_concurrent"]
+            line[f"tok_s_{mode}"] = ab[f"int8_{mode}"]["decode_tok_s"]
+            line[f"pool_blocks_{mode}"] = ab[f"int8_{mode}"]["pool_blocks"]
+        if len(modes) == 2:
+            line["concurrency_ratio"] = ab["concurrency_ratio"]
+            line["speedup_tok_s"] = ab["speedup_tok_s"]
+            line["divergence_rate"] = ab["quality"]["divergence_rate"]
+            line["max_logprob_delta"] = ab["quality"]["max_logprob_delta"]
+            line["quality_ok"] = ab["quality"]["quality_ok"]
+        print(json.dumps(line))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        # the A/B GATES the tentpole's claim IN ONE RUN: at the same pool
+        # bytes, int8 must pack >= 1.8x the concurrent requests AND hold the
+        # pinned logprob-delta/divergence quality budgets
+        if len(modes) == 2 and not (
+            ab["concurrency_ratio"] >= 1.8 and ab["quality"]["quality_ok"]
         ):
             return 1
         return 0

@@ -126,11 +126,21 @@ def test_sharded_engine_lookahead_matches(gpt, expected):
     assert [out[s] for s in slots] == expected
 
 
-def test_mesh_rejects_quantize(gpt):
+def test_mesh_composes_with_quantize(gpt):
+    """The former mutual exclusion is lifted: QuantizedArray {q, scale} leaves
+    get param_shardings entries (scale inherits the kernel's channel-axis
+    split), so the meshed int8 engine streams token-identically to solo int8."""
     model, variables = gpt
     mesh = _mesh({"tensor": 4})
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        DecodeEngine(model, variables, mesh=mesh, quantize="int8")
+    prompt = [3, 1, 4, 1, 5]
+    solo = DecodeEngine(
+        model, variables, num_slots=1, max_len=64, prefill_buckets=(8,), quantize="int8"
+    )
+    meshed = DecodeEngine(
+        model, variables, num_slots=1, max_len=64, prefill_buckets=(8,),
+        quantize="int8", mesh=mesh,
+    )
+    assert meshed.generate(prompt, 8) == solo.generate(prompt, 8)
 
 
 # ------------------------------------------------------------ batched admission

@@ -298,11 +298,13 @@ def test_small_pool_serves_more_concurrent_requests(gpt, gpt_tiny_solo):
 # ------------------------------------------------------- transfer-count fence
 
 
-def test_paged_steady_state_step_pays_zero_uploads(gpt):
+@pytest.mark.parametrize("kv", [None, "int8"], ids=["bf16-pool", "int8-pool"])
+def test_paged_steady_state_step_pays_zero_uploads(gpt, kv):
     """The tentpole's no-new-host-syncs clause: once compiled, the paged
     ``step()`` — table gather included — runs entirely off device-resident
-    state. ``jax.transfer_guard`` turns any regression into a hard error."""
-    engine = make_engine(gpt, paged=True)
+    state, quantized pool included (scales ride the donated pool tree).
+    ``jax.transfer_guard`` turns any regression into a hard error."""
+    engine = make_engine(gpt, paged=True, kv_quantize=kv)
     engine.admit_many([([3, 1, 4, 1, 5], 30, {}), ([2, 7], 30, {})])
     engine.step()  # compile + warm the greedy depth-1 program
     engine.step()
@@ -313,7 +315,7 @@ def test_paged_steady_state_step_pays_zero_uploads(gpt):
     with jax.transfer_guard_host_to_device("disallow"):
         engine.step(4)
     # sampling program: per-row controls ride as device mirrors too
-    sampled = make_engine(gpt, paged=True, temperature=0.8)
+    sampled = make_engine(gpt, paged=True, temperature=0.8, kv_quantize=kv)
     sampled.add_request([3, 1, 4], 30, temperature=0.7, top_k=5, top_p=0.9)
     sampled.step()
     sampled.step()
@@ -338,6 +340,230 @@ def test_paged_prefix_hit_admission_pays_only_explicit_transfers(gpt):
         for _ in range(3):
             engine.step()
     assert engine.prefix_cache.hits == hits_before + 1
+
+
+# --------------------------------------------------- int8 KV pool (ISSUE 14)
+
+
+def _logsoftmax(x):
+    x = x - x.max()
+    return x - np.log(np.exp(x).sum())
+
+
+def _greedy_trace(engine, prompt, n):
+    """One request on an idle pipeline=False engine: per-token greedy stream
+    plus, for token t, the logits it was sampled from (``_last_logits`` holds
+    them between unpipelined steps)."""
+    slot = engine.add_request(list(prompt), n)
+    toks, logits = [], []
+    for _ in range(n):
+        logits.append(np.asarray(engine._last_logits)[slot].copy())
+        toks.extend(ev.token for ev in engine.step() if ev.emit and ev.slot == slot)
+    while engine.busy or engine._inflight is not None or engine.has_pending_events:
+        engine.step()
+    return toks, logits
+
+
+def _divergence(a, b):
+    """(comparable_tokens, tokens_past_first_split): once greedy streams split,
+    the conditioning contexts differ, so only the common prefix is comparable."""
+    m = min(len(a), len(b))
+    first = next((i for i in range(m) if a[i] != b[i]), m)
+    return m, m - first
+
+
+@pytest.mark.parametrize("devices", [1, 4], ids=["1dev", "mesh4"])
+def test_int8_pool_logprob_delta_budget(gpt, devices):
+    """The pinned quality gate: on the common (pre-divergence) prefix, the
+    int8 pool's logprob of the bf16-greedy token stays within
+    KV_INT8_LOGPROB_DELTA_BUDGET, and the divergence rate within
+    KV_INT8_GREEDY_DIVERGENCE_BUDGET — same constants the bench enforces."""
+    from unionml_tpu.ops.quant import (
+        KV_INT8_GREEDY_DIVERGENCE_BUDGET, KV_INT8_LOGPROB_DELTA_BUDGET,
+    )
+
+    mesh = None if devices == 1 else _mesh4()
+    kw = dict(paged=True, mesh=mesh, pipeline=False, prefill_chunk=None, prefix_cache_blocks=0)
+    ref = make_engine(gpt, **kw)
+    quant = make_engine(gpt, kv_quantize="int8", **kw)
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], list(range(20, 29)), [7, 7, 7, 2, 1]]
+    total = diverged = 0
+    max_delta = 0.0
+    for prompt in prompts:
+        t_ref, l_ref = _greedy_trace(ref, prompt, 16)
+        t_q, l_q = _greedy_trace(quant, prompt, 16)
+        m, d = _divergence(t_ref, t_q)
+        total += m
+        diverged += d
+        for i in range(m - d):
+            delta = abs(_logsoftmax(l_ref[i])[t_ref[i]] - _logsoftmax(l_q[i])[t_ref[i]])
+            max_delta = max(max_delta, float(delta))
+    assert total > 0 and diverged / total <= KV_INT8_GREEDY_DIVERGENCE_BUDGET
+    assert max_delta <= KV_INT8_LOGPROB_DELTA_BUDGET
+    _assert_no_block_leaks(quant)
+
+
+@pytest.mark.parametrize("devices", [1, 4], ids=["1dev", "mesh4"])
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "sampled"])
+def test_int8_pool_divergence_budget_mixed_schedule(gpt, devices, sampled):
+    """int8-vs-bf16 token streams across the full mixed schedule (hit / miss /
+    chunked prefill / cancel), greedy and fixed-seed sampled, 1- and 4-device:
+    the per-stream divergence rate stays within the pinned budget."""
+    from unionml_tpu.ops.quant import KV_INT8_GREEDY_DIVERGENCE_BUDGET
+
+    mesh = None if devices == 1 else _mesh4()
+    on, _ = mixed_schedule(
+        make_engine(gpt, paged=True, mesh=mesh, seed=7, kv_quantize="int8"), sampled=sampled
+    )
+    off, _ = mixed_schedule(make_engine(gpt, paged=True, mesh=mesh, seed=7), sampled=sampled)
+    total = diverged = 0
+    for req in on:
+        m, d = _divergence(on[req], off[req])
+        total += m
+        diverged += d
+    assert total > 0 and diverged / total <= KV_INT8_GREEDY_DIVERGENCE_BUDGET
+
+
+def test_int8_skip_all_layers_is_bitwise_bf16(gpt):
+    """kv_quantize_skip_layers is a real bf16 fallback: skipping EVERY layer
+    reproduces the full-precision stream bitwise, and a partial skip leaves
+    exactly the listed layers' pool leaves unscaled."""
+    import jax.numpy as jnp
+
+    prompt = [3, 1, 4, 1, 5, 9]
+    full = make_engine(gpt, paged=True).generate(prompt, 10)
+    skip_all = make_engine(
+        gpt, paged=True, kv_quantize="int8", kv_quantize_skip_layers=(0, 1)
+    )
+    assert skip_all.generate(prompt, 10) == full
+    partial = make_engine(gpt, paged=True, kv_quantize="int8", kv_quantize_skip_layers=(0,))
+    assert "k_scale" not in partial._pool["layer_0"]
+    assert partial._pool["layer_1"]["k"].dtype == jnp.int8
+    assert partial._pool["layer_1"]["k_scale"].dtype == jnp.float32
+
+
+def test_int8_chaos_teardowns_leak_no_blocks(gpt):
+    """Satellite: the chaos schedules under kv_quantize="int8" — cancel
+    mid-chunked-prefill, abort_all racing a dispatched step, reset, the full
+    mixed schedule — leave zero leaked / double-freed blocks (scales share the
+    k/v block ids, so block accounting covers them by construction)."""
+    engine = make_engine(gpt, paged=True, kv_quantize="int8")
+    mixed_schedule(engine)
+    _assert_no_block_leaks(engine)
+    engine = make_engine(gpt, paged=True, num_slots=3, kv_quantize="int8")
+    (slot,) = engine.admit_many([(list(range(1, 15)), 6)])
+    assert engine.has_pending_prefill
+    engine.cancel(slot)
+    _assert_no_block_leaks(engine)
+    engine.admit_many([([3, 1, 4], 20, {}), ([2, 7], 20, {})])
+    engine.step()
+    engine.step()
+    engine.abort_all()
+    _assert_no_block_leaks(engine)
+    stats = engine._allocator.stats()
+    assert stats["free_blocks"] + stats["cached_blocks"] == engine._allocator.num_blocks
+    engine.reset()
+    engine.generate([5, 6, 7], 4)
+    _assert_no_block_leaks(engine)
+
+
+def test_int8_preempt_resume_and_rebuild_leak_no_blocks(gpt):
+    """Preempt (block adoption), resume (splice + suffix requantization), and
+    a fault-injected rebuild all run on the quantized pool with zero leaks.
+    Streams are budgeted elsewhere, not bit-pinned: a resume requantizes the
+    suffix from a fresh forward, which may round differently than the
+    incremental appends it replaces."""
+    from unionml_tpu.serving.continuous import PreemptedSlot
+
+    engine = make_engine(gpt, paged=True, kv_quantize="int8")
+    slot = engine.add_request([3, 1, 4, 1, 5, 9, 2, 6], 12)
+    for _ in range(4):
+        engine.step()
+    state = engine.preempt(slot)
+    assert state is not None
+    engine.take_pending_events()
+    engine.add_request(state.tokens, 8)
+    engine.release_preempted(state)
+    while engine.busy or engine._inflight is not None or engine.has_pending_events:
+        engine.step()
+    _assert_no_block_leaks(engine)
+
+    engine = make_engine(
+        gpt, paged=True, kv_quantize="int8", faults=FaultPlan(step_dispatch_failures=(3,))
+    )
+    engine.add_request([3, 1, 4, 1, 5], 10)
+    with pytest.raises(FaultError):
+        while True:
+            engine.step()
+    salvage = engine.take_salvage()
+    assert len(salvage) == 1 and engine._allocator.slot_blocks == 0
+    engine.add_request(salvage[0].tokens, salvage[0].remaining)
+    engine.release_preempted(PreemptedSlot(tokens=salvage[0].tokens, path=salvage[0].path))
+    while engine.num_active or engine.has_pending_prefill or engine.has_pending_events:
+        engine.step()
+    _assert_no_block_leaks(engine)
+
+
+def test_int8_equal_byte_pool_doubles_capacity_and_reports_it(gpt):
+    """Equal KV bytes buy ≥2× the blocks: the int8 pool admits 4 concurrent
+    requests where the byte-equivalent bf16 pool admits 1, and exhaustion's
+    structured failure reports the doubled block count."""
+    from unionml_tpu.models.gpt import kv_block_bytes
+
+    model, _ = gpt
+    cfg = model.config
+    bf16_blocks = 13
+    byte_budget = bf16_blocks * kv_block_bytes(cfg, BS)
+    int8_blocks = byte_budget // kv_block_bytes(cfg, BS, kv_quantize="int8")
+    assert int8_blocks >= 2 * bf16_blocks  # the doubling, from layout math alone
+    engine = make_engine(
+        gpt, paged=True, num_slots=8, pool_blocks=int(int8_blocks),
+        prefix_cache_blocks=0, kv_quantize="int8",
+    )
+    # each request demands ceil((3+40)/4) = 11 blocks: one fills the 12-usable
+    # bf16 pool (see test_pool_exhaustion_is_structured_and_retryable); four
+    # fit the equal-byte int8 pool concurrently
+    slots = engine.admit_many([([i, i + 1, i + 2], 40, {}) for i in range(1, 5)])
+    assert len(slots) == 4
+    with pytest.raises(EngineFailure) as err:
+        engine.admit_many([([9, 9, 9], 40, {})])
+    assert err.value.reason == "pool_exhausted" and err.value.retryable
+    assert f"of {int(int8_blocks) - 1}" in str(err.value)  # the doubled count
+    engine.abort_all()
+    _assert_no_block_leaks(engine)
+
+
+def test_weight_int8_composes_with_mesh(gpt):
+    """Satellite: quantize="int8" + mesh are no longer mutually exclusive —
+    the QuantizedArray {q, scale} leaves get param_shardings entries, and the
+    meshed int8 engine is token-identical to the solo int8 engine."""
+    mesh = _mesh4()
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    solo = make_engine(gpt, paged=True, quantize="int8").generate(prompt, 10)
+    meshed = make_engine(gpt, paged=True, quantize="int8", mesh=mesh).generate(prompt, 10)
+    assert meshed == solo
+
+
+# ------------------------------------------------------------- re-layout parity
+
+
+@pytest.mark.parametrize("kv", [None, "int8"], ids=["bf16", "int8kv"])
+def test_post_construction_enable_relayout_parity(gpt, kv):
+    """The serving-app path builds the engine WITHOUT a ctor prefix cache and
+    calls ``enable_prefix_cache`` afterwards, re-laying-out the pool to a new
+    block size. The paged programs must pick the new layout up at retrace —
+    a stale __init__-captured block size silently corrupted tokens (bf16) or
+    crashed _paged_insert's quantized scatter with a shape error (int8)."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    ctor = make_engine(gpt, paged=True, kv_quantize=kv)
+    relayout = make_engine(
+        gpt, paged=True, kv_quantize=kv, prefix_cache_blocks=0, prefix_block_size=16
+    )
+    relayout.enable_prefix_cache(24, BS)
+    assert relayout._prefix_block_size == ctor._prefix_block_size == BS
+    assert relayout.pool_blocks == ctor.pool_blocks
+    assert relayout.generate(prompt, 12) == ctor.generate(prompt, 12)
+    _assert_no_block_leaks(relayout)
 
 
 # ------------------------------------------------------------------ compat flag

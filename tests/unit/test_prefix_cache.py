@@ -364,6 +364,10 @@ def test_stats_route_reports_prefix_cache(gpt):
     assert generation["prefix_cache"]["block_size"] == BS
     assert generation["prefix_cache"]["hits"] == 1
     assert generation["prefill_tokens_computed"] < 2 * 11
+    # the kv_pool_stats merge (PR 14): pool dtype + resident-byte accounting
+    assert generation["prefix_cache"]["kv_dtype"] == "float32"  # tiny cfg on CPU
+    assert (0 < generation["prefix_cache"]["kv_pool_bytes"]
+            == generation["prefix_cache"]["kv_pool_bytes_dense_equiv"])
 
 
 # ------------------------------------------------- pipelined-step race fencing

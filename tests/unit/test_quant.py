@@ -8,11 +8,41 @@ import pytest
 from unionml_tpu.ops.quant import (
     QuantizedArray,
     default_should_quantize,
+    dequantize_blockwise,
     dequantize_tree,
     quantize_array,
+    quantize_blockwise,
     quantize_tree,
     quantized_bytes,
 )
+
+
+def test_blockwise_roundtrip_error_bounded_by_half_scale():
+    """The KV-pool primitive: per-(block, head) absmax scales over the
+    (position, head_dim) axes, round-trip error within scale/2 per element."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(6, 4, 16, 8)), dtype=jnp.float32)
+    q, scale = quantize_blockwise(x, reduce_axes=(2, 3))
+    assert q.dtype == jnp.int8 and scale.shape == (6, 4, 1, 1)
+    err = np.abs(np.asarray(dequantize_blockwise(q, scale)) - np.asarray(x))
+    assert np.all(err <= np.asarray(scale) / 2 + 1e-7)
+    # dtype plumbing: the dequant target is honored
+    assert dequantize_blockwise(q, scale, jnp.bfloat16).dtype == jnp.bfloat16
+
+
+def test_blockwise_zero_block_stores_zero_scale():
+    """All-zero blocks store scale 0 (NOT the weight-tree convention of 1.0):
+    the pool's monotone-scale append relies on an empty block never raising
+    the max, and q * 0 still dequantizes to exactly zero."""
+    x = jnp.zeros((3, 2, 4, 4), jnp.float32)
+    q, scale = quantize_blockwise(x, reduce_axes=(2, 3))
+    np.testing.assert_array_equal(np.asarray(scale), 0.0)
+    np.testing.assert_array_equal(np.asarray(dequantize_blockwise(q, scale)), 0.0)
+    # one hot block must not leak its scale into its all-zero neighbors
+    y = np.zeros((2, 1, 4, 4), np.float32)
+    y[1] = 100.0
+    _, scale = quantize_blockwise(jnp.asarray(y), reduce_axes=(2, 3))
+    assert float(scale[0, 0, 0, 0]) == 0.0 and float(scale[1, 0, 0, 0]) > 0.0
 
 
 def test_roundtrip_error_bounded_by_half_scale():

@@ -99,6 +99,10 @@ run serving_pipeline 300 python bench_serving.py --pipeline ab
 # paged packs < 1.5x the concurrent requests or the greedy streams diverge
 # by a single token — the tentpole's claim, measured on hardware)
 run serving_paged 300 python bench_serving.py --paged ab
+# int8 KV pool A/B at equal pool bytes: >= 1.8x peak concurrency vs the bf16
+# paged pool AND the pinned logprob-delta/divergence quality budgets, gated
+# in the same run (exits nonzero on either failure)
+run serving_int8 300 python bench_serving.py --int8 ab
 # telemetry overhead A/B: span tracing + metrics on vs off over the same
 # concurrent mix — best-of-3 decode tok/s per arm (the phase exits nonzero
 # when the enabled arm regresses more than 2%, holding the zero-overhead

@@ -162,6 +162,9 @@ SPECS: Tuple[ResourceSpec, ...] = (
         # the grant in _slot_block_map and returns the ids — '# transfers:'),
         # and releases on finish/cancel/preempt/rebuild (_free_slot_blocks,
         # the '# owns:' release point) or by adoption into the radix index.
+        # kv_quantize="int8" adds no paths here: the scale arrays are pool
+        # device leaves indexed by the SAME block ids this grant tracks, so
+        # the existing acquire/release sites cover their lifetime too.
         "kv-block",
         "slot-owned KV block grant",
         acquires=(

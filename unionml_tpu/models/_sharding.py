@@ -5,15 +5,23 @@ function; the path flattening / key normalization / tree reconstruction live her
 a fix for new jax key types lands once for every family.
 """
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 
 
-def shard_by_rules(params: Any, spec_for: Callable[[Tuple[str, ...], Any], Any]) -> Any:
-    """Apply ``spec_for((path parts), leaf) -> PartitionSpec`` over a parameter tree."""
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    treedef = jax.tree_util.tree_structure(params)
+def shard_by_rules(
+    params: Any,
+    spec_for: Callable[[Tuple[str, ...], Any], Any],
+    is_leaf: Optional[Callable[[Any], bool]] = None,
+) -> Any:
+    """Apply ``spec_for((path parts), leaf) -> PartitionSpec`` over a parameter tree.
+
+    ``is_leaf`` stops flattening at composite leaves (e.g. ``QuantizedArray``
+    nodes) so ``spec_for`` sees the whole node and can return a matching
+    composite spec node instead of per-child specs."""
+    flat = jax.tree_util.tree_flatten_with_path(params, is_leaf=is_leaf)[0]
+    treedef = jax.tree_util.tree_structure(params, is_leaf=is_leaf)
     specs = [
         spec_for(tuple(getattr(k, "key", getattr(k, "idx", k)) for k in path), leaf)
         for path, leaf in flat

@@ -72,6 +72,82 @@ class GPTConfig:
         return self.hidden_size // self.num_heads
 
 
+def _paged_append_quantized(pool_q, pool_scale, dst, off, vals):
+    """(jit-traceable) Single-token decode append into an int8 pool tail block.
+
+    ``dst`` (batch,) pool block per row, ``off`` (batch,) offset inside it,
+    ``vals`` (batch, heads, head_dim) the new token's K or V. Monotone-scale
+    read-modify-write: a block's per-head scale resets on its first write
+    (``off == 0``), afterwards only ever GROWS (``max(old, |token|/127)``), and
+    the block's existing int8 content is rescaled only on an actual growth
+    event — when the scale is unchanged the ratio is exactly 1.0 and the
+    rescale is a bit-exact no-op, so rounding error does not compound across
+    appends. Offsets past the write point are zeroed, scrubbing whatever a
+    previous owner left in a reused block. Rows retired to the scratch block
+    carry sentinel positions with ``off == 0`` (see the paged contract), so
+    their collisions write self-consistent garbage to scratch only.
+    """
+    bs = pool_q.shape[2]
+    old_q = pool_q[dst].astype(jnp.float32)  # (batch, heads, bs, hd)
+    old_scale = pool_scale[dst]  # (batch, heads, 1, 1)
+    vals32 = vals.astype(jnp.float32)[:, :, None, :]  # (batch, heads, 1, hd)
+    tok_scale = jnp.max(jnp.abs(vals32), axis=-1, keepdims=True) / 127.0
+    fresh = (off == 0)[:, None, None, None]
+    eff_old = jnp.where(fresh, 0.0, old_scale)
+    new_scale = jnp.maximum(eff_old, tok_scale)
+    safe = jnp.where(new_scale > 0, new_scale, 1.0)
+    rescaled = jnp.round(old_q * (eff_old / safe))
+    tok_q = jnp.round(vals32 / safe)
+    slot_idx = jnp.arange(bs)[None, None, :, None]
+    off_b = off[:, None, None, None]
+    new_q = jnp.where(slot_idx < off_b, rescaled, jnp.where(slot_idx == off_b, tok_q, 0.0))
+    new_q = jnp.clip(new_q, -127, 127).astype(jnp.int8)
+    return pool_q.at[dst].set(new_q), pool_scale.at[dst].set(new_scale)
+
+
+def _paged_chunk_quantized(pool_q, pool_scale, table_row, position, vals):
+    """(jit-traceable) Batch-1 chunk prefill into an int8 pool.
+
+    ``vals`` (heads, seq, head_dim) is the chunk's K or V for positions
+    ``[position, position + seq)``; ``table_row`` (width,) maps logical blocks
+    to pool blocks. Touches only the ``ceil(seq/bs) + 1`` blocks the chunk can
+    reach from ``position // bs`` (a straddling chunk spans one extra) — blocks
+    BEFORE the write range are never read or written, which is what keeps a
+    spliced shared prefix intact. The same monotone-scale discipline as the
+    decode append applies: the first block may be mid-block (fresh only when
+    the chunk starts at its offset 0), later blocks are fresh by construction.
+    Logical blocks past the row's table width clamp to the trailing scratch
+    column. Positions past the chunk's end are zeroed (stale-content scrub).
+    """
+    heads, seq, head_dim = vals.shape
+    bs = pool_q.shape[2]
+    width = table_row.shape[0]
+    nb = -(-seq // bs) + 1  # static: touched blocks, incl. the straddle block
+    position = jnp.asarray(position, jnp.int32)
+    blk_idx = position // bs + jnp.arange(nb, dtype=jnp.int32)
+    dst = jnp.take(table_row, jnp.clip(blk_idx, 0, width - 1))
+    old_q = pool_q[dst].astype(jnp.float32)  # (nb, heads, bs, hd)
+    old_scale = pool_scale[dst]  # (nb, heads, 1, 1)
+    gpos = blk_idx[:, None] * bs + jnp.arange(bs)[None, :]  # (nb, bs) logical positions
+    rel = gpos - position
+    write = ((rel >= 0) & (rel < seq))[:, None, :, None]  # chunk content lands here
+    live = (gpos < position + seq)[:, None, :, None]  # beyond: scrub to zero
+    chunk = jnp.moveaxis(vals, 1, 0).astype(jnp.float32)  # (seq, heads, hd)
+    take = jnp.take(chunk, jnp.clip(rel.reshape(-1), 0, seq - 1), axis=0)
+    take = jnp.moveaxis(take.reshape(nb, bs, heads, head_dim), 2, 1)  # (nb, heads, bs, hd)
+    fresh = (blk_idx * bs >= position)[:, None, None, None]
+    eff_old = jnp.where(fresh, 0.0, old_scale)
+    chunk_absmax = jnp.max(
+        jnp.abs(jnp.where(write, take, 0.0)), axis=(2, 3), keepdims=True
+    )
+    new_scale = jnp.maximum(eff_old, chunk_absmax / 127.0)
+    safe = jnp.where(new_scale > 0, new_scale, 1.0)
+    rescaled = jnp.round(old_q * (eff_old / safe))
+    new_q = jnp.where(write, jnp.round(take / safe), rescaled)
+    new_q = jnp.clip(jnp.where(live, new_q, 0.0), -127, 127).astype(jnp.int8)
+    return pool_q.at[dst].set(new_q), pool_scale.at[dst].set(new_scale)
+
+
 class DecoderBlock(nn.Module):
     config: GPTConfig
     use_moe: bool = False
@@ -163,33 +239,60 @@ class DecoderBlock(nn.Module):
             block_size = cache["k"].shape[2]
             width = block_table.shape[1]
             capacity = width * block_size
+            # an int8-quantized pool announces itself structurally: scale leaves
+            # ride next to k/v (see init_block_pool), so skip-listed layers fall
+            # through to the full-precision path with zero config plumbing
+            quantized = "k_scale" in cache
+            k_scale = v_scale = None
             if per_row:
                 # decode: each row appends one token into its own tail block
                 pos = jnp.clip(position.astype(jnp.int32), 0, capacity - 1)
                 blk, off = pos // block_size, pos % block_size
                 dst = jnp.take_along_axis(block_table, blk[:, None], axis=1)[:, 0]
-                k_cache = cache["k"].at[dst, :, off, :].set(k[:, :, 0, :].astype(cache["k"].dtype))
-                v_cache = cache["v"].at[dst, :, off, :].set(v[:, :, 0, :].astype(cache["v"].dtype))
+                if quantized:
+                    k_cache, k_scale = _paged_append_quantized(
+                        cache["k"], cache["k_scale"], dst, off, k[:, :, 0, :]
+                    )
+                    v_cache, v_scale = _paged_append_quantized(
+                        cache["v"], cache["v_scale"], dst, off, v[:, :, 0, :]
+                    )
+                else:
+                    k_cache = cache["k"].at[dst, :, off, :].set(k[:, :, 0, :].astype(cache["k"].dtype))
+                    v_cache = cache["v"].at[dst, :, off, :].set(v[:, :, 0, :].astype(cache["v"].dtype))
             else:
                 # chunked prefill through the table (batch=1): scatter the chunk's
                 # K/V at positions [position, position+seq) of row 0's blocks
                 if batch != 1:
                     raise ValueError("paged chunk prefill requires batch == 1")
-                pos = jnp.clip((position + jnp.arange(seq)).astype(jnp.int32), 0, capacity - 1)
-                blk, off = pos // block_size, pos % block_size
-                dst = jnp.take(block_table[0], blk)
-                k_cache = cache["k"].at[dst, :, off, :].set(
-                    jnp.moveaxis(k[0], 1, 0).astype(cache["k"].dtype)
-                )
-                v_cache = cache["v"].at[dst, :, off, :].set(
-                    jnp.moveaxis(v[0], 1, 0).astype(cache["v"].dtype)
-                )
+                if quantized:
+                    k_cache, k_scale = _paged_chunk_quantized(
+                        cache["k"], cache["k_scale"], block_table[0], position, k[0]
+                    )
+                    v_cache, v_scale = _paged_chunk_quantized(
+                        cache["v"], cache["v_scale"], block_table[0], position, v[0]
+                    )
+                else:
+                    pos = jnp.clip((position + jnp.arange(seq)).astype(jnp.int32), 0, capacity - 1)
+                    blk, off = pos // block_size, pos % block_size
+                    dst = jnp.take(block_table[0], blk)
+                    k_cache = cache["k"].at[dst, :, off, :].set(
+                        jnp.moveaxis(k[0], 1, 0).astype(cache["k"].dtype)
+                    )
+                    v_cache = cache["v"].at[dst, :, off, :].set(
+                        jnp.moveaxis(v[0], 1, 0).astype(cache["v"].dtype)
+                    )
 
-            def gather_table(pool_leaf):
+            def gather_table(pool_leaf, scale_leaf=None):
                 # (batch, width, heads, bs, hd) -> (batch, heads, width*bs, hd):
                 # logical position p lands at flattened column blk*bs+off == p,
                 # so downstream masking is position arithmetic, same as dense
                 blocks = pool_leaf[block_table]
+                if scale_leaf is not None:
+                    # dequantize inside the gather: int8 is what crossed HBM, the
+                    # per-block-per-head scale rides the same table gather (shard-
+                    # local under the head-sharded pool spec), and empty blocks
+                    # (scale 0) decode to exact zeros the mask already discards
+                    blocks = (blocks.astype(jnp.float32) * scale_leaf[block_table]).astype(cfg.dtype)
                 return jnp.moveaxis(blocks, 2, 1).reshape(
                     batch, cfg.num_heads, capacity, cfg.head_dim
                 )
@@ -201,8 +304,13 @@ class DecoderBlock(nn.Module):
             else:
                 q_pos = position + jnp.arange(seq)
                 mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
-            context = xla_attention(q, gather_table(k_cache), gather_table(v_cache), mask=mask)
+            context = xla_attention(
+                q, gather_table(k_cache, k_scale), gather_table(v_cache, v_scale), mask=mask
+            )
             new_cache = {"k": k_cache, "v": v_cache}
+            if quantized:
+                new_cache["k_scale"] = k_scale
+                new_cache["v_scale"] = v_scale
         else:
             per_row = not isinstance(position, int) and jnp.ndim(position) == 1
             if per_row and seq != 1:
@@ -414,7 +522,12 @@ def kv_cache_spec(config: GPTConfig, mesh_axis_names: Tuple[str, ...]) -> Any:
 
 
 def init_block_pool(
-    config: GPTConfig, num_blocks: int, block_size: int, dtype: Any = None
+    config: GPTConfig,
+    num_blocks: int,
+    block_size: int,
+    dtype: Any = None,
+    kv_quantize: Optional[str] = None,
+    kv_quantize_skip_layers: Tuple[int, ...] = (),
 ) -> Dict[str, Any]:
     """Zeroed KV block pool for prefix caching: ``(num_blocks, heads, block_size,
     head_dim)`` per layer, the serving engine's reuse store for prompt-prefix KV.
@@ -423,16 +536,74 @@ def init_block_pool(
     with the identical head-sharded spec (:func:`kv_block_spec`) and pool↔slot
     copies stay shard-local on a mesh (gather/scatter over the unsharded block
     axis only).
+
+    ``kv_quantize="int8"`` stores K/V as symmetric int8 with per-block-per-head
+    f32 scales resident alongside (``k_scale``/``v_scale``, shape ``(blocks,
+    heads, 1, 1)`` — rank-4 so the one head-sharded spec covers every leaf and
+    scale gathers stay shard-local). Layers listed in
+    ``kv_quantize_skip_layers`` keep full-precision leaves (no scale entries) —
+    the attention layer detects the mode structurally per layer, so mixed pools
+    need no extra plumbing.
     """
     dtype = dtype if dtype is not None else config.dtype
+    if kv_quantize not in (None, "int8"):
+        raise ValueError(f"kv_quantize must be None or 'int8', got {kv_quantize!r}")
+    skip = frozenset(int(i) for i in kv_quantize_skip_layers)
     shape = (num_blocks, config.num_heads, block_size, config.head_dim)
-    return {
-        f"layer_{i}": {
-            "k": jnp.zeros(shape, dtype=dtype),
-            "v": jnp.zeros(shape, dtype=dtype),
-        }
-        for i in range(config.num_layers)
-    }
+    scale_shape = (num_blocks, config.num_heads, 1, 1)
+    pool: Dict[str, Any] = {}
+    for i in range(config.num_layers):
+        if kv_quantize == "int8" and i not in skip:
+            pool[f"layer_{i}"] = {
+                "k": jnp.zeros(shape, dtype=jnp.int8),
+                "v": jnp.zeros(shape, dtype=jnp.int8),
+                "k_scale": jnp.zeros(scale_shape, dtype=jnp.float32),
+                "v_scale": jnp.zeros(scale_shape, dtype=jnp.float32),
+            }
+        else:
+            pool[f"layer_{i}"] = {
+                "k": jnp.zeros(shape, dtype=dtype),
+                "v": jnp.zeros(shape, dtype=dtype),
+            }
+    return pool
+
+
+def kv_block_bytes(
+    config: GPTConfig,
+    block_size: int,
+    dtype: Any = None,
+    kv_quantize: Optional[str] = None,
+    kv_quantize_skip_layers: Tuple[int, ...] = (),
+) -> int:
+    """Bytes one pool block costs across ALL layers under the given layout —
+    the unit of the equal-KV-byte A/B (`bench_serving --int8 ab`) and of pool
+    sizing: ``pool_bytes = kv_block_bytes(...) * num_blocks``."""
+    dtype = dtype if dtype is not None else config.dtype
+    full_itemsize = jnp.dtype(dtype).itemsize
+    per_head = block_size * config.head_dim
+    skip = frozenset(int(i) for i in kv_quantize_skip_layers)
+    total = 0
+    for i in range(config.num_layers):
+        if kv_quantize == "int8" and i not in skip:
+            # int8 k + v, plus one f32 scale each per head
+            total += config.num_heads * (2 * per_head * 1 + 2 * 4)
+        else:
+            total += config.num_heads * 2 * per_head * full_itemsize
+    return total
+
+
+def kv_pool_bytes(pool: Dict[str, Any], dense_dtype: Any) -> Tuple[int, int]:
+    """(bytes_as_stored, bytes_if_full_precision) of a block pool, from shapes
+    only (no device sync). The second number prices the same K/V positions at
+    ``dense_dtype`` with no scale arrays — what the capacity doubling is
+    measured against on dashboards."""
+    stored = full = 0
+    for layer in pool.values():
+        for name, leaf in layer.items():
+            stored += leaf.size * jnp.dtype(leaf.dtype).itemsize
+            if not name.endswith("_scale"):
+                full += leaf.size * jnp.dtype(dense_dtype).itemsize
+    return stored, full
 
 
 def init_slot_state(num_slots: int) -> Tuple[jax.Array, jax.Array]:
@@ -665,11 +836,16 @@ def param_shardings(params: Any, mesh_axis_names: Tuple[str, ...] = ("data", "te
     - MoE expert kernels (E, d, h)/(E, h, d): expert dim over ``expert`` when that
       axis exists, inner dims Megatron-split like the dense MLP
     - everything else replicated, or FSDP-sharded over ``fsdp`` when present
+    - :class:`~unionml_tpu.ops.quant.QuantizedArray` leaves (weight-only int8):
+      the int8 payload takes the kernel's spec; the scale keeps only the axes
+      where it has extent (the channel axis), so it co-shards with the payload's
+      output columns and the ``q * scale`` dequant runs without resharding
 
     XLA inserts the matching all-reduces over ICI; nothing else is needed.
     """
     from jax.sharding import PartitionSpec as P
 
+    from unionml_tpu.ops.quant import QuantizedArray
     from unionml_tpu.parallel.ep import EXPERT_AXIS
     from unionml_tpu.parallel.mesh import FSDP_AXIS, TENSOR_AXIS
 
@@ -677,8 +853,7 @@ def param_shardings(params: Any, mesh_axis_names: Tuple[str, ...] = ("data", "te
     fsdp = FSDP_AXIS if FSDP_AXIS in mesh_axis_names else None
     expert = EXPERT_AXIS if EXPERT_AXIS in mesh_axis_names else None
 
-    def spec_for(path: Tuple[str, ...], leaf) -> P:
-        path_str = "/".join(str(p) for p in path)
+    def dense_spec(path_str: str, leaf) -> P:
         ndim = getattr(leaf, "ndim", 0)
         if "w_in" in path_str and ndim == 3:
             return P(expert, fsdp, tensor)
@@ -696,9 +871,27 @@ def param_shardings(params: Any, mesh_axis_names: Tuple[str, ...] = ("data", "te
             return P(fsdp, None)
         return P()
 
+    def spec_for(path: Tuple[str, ...], leaf):
+        path_str = "/".join(str(p) for p in path)
+        if isinstance(leaf, QuantizedArray):
+            base = dense_spec(path_str, leaf.q)
+            entries = tuple(base) + (None,) * (leaf.q.ndim - len(tuple(base)))
+            scale_spec = P(
+                *(
+                    axis if i < leaf.scale.ndim and leaf.scale.shape[i] > 1 else None
+                    for i, axis in enumerate(entries)
+                )
+            )
+            # a spec-valued QuantizedArray node: same treedef (incl. dtype aux)
+            # as the params node, so device_put/with_sharding_constraint zip them
+            return QuantizedArray(q=base, scale=scale_spec, dtype=leaf.dtype)
+        return dense_spec(path_str, leaf)
+
     from unionml_tpu.models._sharding import shard_by_rules
 
-    return shard_by_rules(params, spec_for)
+    return shard_by_rules(
+        params, spec_for, is_leaf=lambda leaf: isinstance(leaf, QuantizedArray)
+    )
 
 
 def import_hf_weights(hf_state_dict: Dict[str, Any], config: GPTConfig) -> Dict[str, Any]:

@@ -27,13 +27,26 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "KV_INT8_GREEDY_DIVERGENCE_BUDGET",
+    "KV_INT8_LOGPROB_DELTA_BUDGET",
     "QuantizedArray",
     "default_should_quantize",
+    "dequantize_blockwise",
     "dequantize_tree",
     "quantize_array",
+    "quantize_blockwise",
     "quantize_tree",
     "quantized_bytes",
 ]
+
+# Pinned quality budgets for the int8 KV block pool, enforced by both the unit
+# tests (tests/unit/test_paged_kv.py) and the `bench_serving --int8 ab` gate so
+# a regression in either place fails the same numbers. Measured on the tiny CPU
+# config with ~3x headroom over observed worst cases; budgets are on the
+# pre-divergence prefix (once greedy streams split, the contexts differ and
+# per-token comparison stops being meaningful).
+KV_INT8_LOGPROB_DELTA_BUDGET = 0.15  # max |Δ logprob| of the bf16-greedy token
+KV_INT8_GREEDY_DIVERGENCE_BUDGET = 0.35  # max fraction of tokens past first split
 
 
 @jax.tree_util.register_pytree_node_class
@@ -61,6 +74,31 @@ class QuantizedArray:
         return cls(q=q, scale=scale, dtype=dtype)
 
 
+def quantize_blockwise(x: jax.Array, reduce_axes: Tuple[int, ...]) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 with per-block absmax scales.
+
+    A "block" is one element of the axes NOT in ``reduce_axes``: the absmax
+    reduction runs over ``reduce_axes`` (keepdims), ``scale = absmax / 127``,
+    and ``q = clip(round(x / scale), -127, 127)``. An all-zero block stores
+    ``scale == 0`` — the convention the KV pool relies on so an empty block
+    cannot poison the monotone-scale max on its first real write; division is
+    guarded internally, and ``dequantize_blockwise`` maps ``q * 0 == 0`` back
+    exactly. Round-trip error is bounded by ``scale / 2`` per element.
+    """
+    x32 = jnp.asarray(x, dtype=jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=reduce_axes, keepdims=True)
+    scale = absmax / 127.0
+    q = jnp.clip(jnp.round(x32 / jnp.where(scale > 0, scale, 1.0)), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_blockwise(q: jax.Array, scale: jax.Array, dtype: Any = jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_blockwise` (up to rounding): ``q * scale``
+    in f32, cast to ``dtype``. Inside jit the multiply fuses into the consumer,
+    so int8 is what crosses HBM."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 def quantize_array(w: jax.Array, channel_axis: int = -1) -> QuantizedArray:
     """Symmetric per-channel int8 quantization.
 
@@ -71,9 +109,10 @@ def quantize_array(w: jax.Array, channel_axis: int = -1) -> QuantizedArray:
     neighbors."""
     w32 = jnp.asarray(w, dtype=jnp.float32)
     reduce_axes = tuple(i for i in range(w32.ndim) if i != channel_axis % w32.ndim)
-    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    q, scale = quantize_blockwise(w32, reduce_axes)
+    # weight trees keep the historical scale==1.0 convention for all-zero
+    # channels (dequantize is identical either way; 1.0 keeps scales invertible)
+    scale = jnp.where(scale > 0, scale, 1.0)
     return QuantizedArray(q=q, scale=scale, dtype=jnp.asarray(w).dtype)
 
 

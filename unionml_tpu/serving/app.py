@@ -752,6 +752,11 @@ def build_aiohttp_app(
                 payload["generation"]["prefill_tokens_computed"] = (
                     gen.engine.prefill_tokens_computed
                 )
+                kv_stats = getattr(gen.engine, "kv_pool_stats", None)
+                if callable(kv_stats):
+                    # pool dtype + resident bytes (stored vs priced at the
+                    # dense compute dtype) — the kv_quantize="int8" saving
+                    payload["generation"]["prefix_cache"].update(kv_stats())
             sched = getattr(gen, "scheduler", None)
             if sched is not None and callable(getattr(sched, "stats", None)):
                 # SLO scheduler observability: per-class queue depth,

@@ -225,6 +225,19 @@ class DecodeEngine:
         prefix_cache_blocks + 1`` — i.e. dense-equivalent capacity semantics;
         pass an explicit smaller value to serve more concurrent short requests
         than dense could at the same KV byte budget (the paged bench arm).
+    :param kv_quantize: ``"int8"`` stores the paged block pool as symmetric
+        int8 with per-block-per-head f32 scales resident alongside (see
+        :func:`unionml_tpu.models.gpt.init_block_pool`) — int8 is what crosses
+        HBM on every decode gather, so a fixed byte budget holds ~2× the
+        blocks of a bf16 pool. All writes quantize in-program (prefill insert,
+        chunk prefill, the in-place decode append) and the gather dequantizes
+        inside the same compiled step; allocation/splice/adopt/preempt move
+        block IDs only, so the scheduler is oblivious. Requires ``paged=True``.
+        Quality is budgeted, not bit-exact: see the pinned
+        ``KV_INT8_*_BUDGET`` constants in :mod:`unionml_tpu.ops.quant`.
+    :param kv_quantize_skip_layers: layer indices whose pool stays full
+        precision (outlier-sensitive layers); their leaves simply carry no
+        scale arrays, which is how the attention layer detects the mode.
     :param faults: a :class:`~unionml_tpu.serving.faults.FaultPlan` arming
         deterministic fault injection (chaos tests and ``bench_serving
         --chaos`` only). ``None`` (production) makes every hook a single host
@@ -252,6 +265,8 @@ class DecodeEngine:
         pipeline: bool = True,
         paged: bool = True,
         pool_blocks: Optional[int] = None,
+        kv_quantize: Optional[str] = None,
+        kv_quantize_skip_layers: Sequence[int] = (),
         faults: Optional[FaultPlan] = None,
         telemetry: Optional[Any] = None,
     ) -> None:
@@ -266,10 +281,14 @@ class DecodeEngine:
             )
         if quantize not in (None, "int8"):
             raise ValueError(f"Unknown quantize mode {quantize!r}; expected None or 'int8'")
-        if quantize is not None and mesh is not None:
-            # the int8 tree's {q, scale} leaves have no entries in the sharding
-            # rule table; serving them sharded would silently replicate weights
-            raise ValueError("quantize and mesh are mutually exclusive (for now)")
+        if kv_quantize not in (None, "int8"):
+            raise ValueError(f"Unknown kv_quantize mode {kv_quantize!r}; expected None or 'int8'")
+        if kv_quantize is not None and not paged:
+            raise ValueError("kv_quantize requires paged=True (the block pool is what quantizes)")
+        # quantize + mesh compose: quantization happens first (below), then
+        # param_shardings assigns the int8 tree's {q, scale} leaves their specs
+        # (the scale inherits the kernel's channel-axis split) and place_by_specs
+        # lays the QuantizedArray nodes onto the mesh like any other leaf
         if quantize == "int8":
             from unionml_tpu.ops.quant import dequantize_tree, quantize_tree
 
@@ -435,6 +454,14 @@ class DecodeEngine:
         #: are always written by the new owner before its attention reads them.
         self._slot_block_map: Dict[int, Dict[int, int]] = {}  # holds: kv-block
         self._explicit_pool_blocks = pool_blocks is not None
+        #: int8 KV pool mode ("int8" or None) + the layers kept full-precision
+        self.kv_quantize = kv_quantize
+        self.kv_quantize_skip_layers = tuple(int(i) for i in kv_quantize_skip_layers)
+        if any(i < 0 or i >= config.num_layers for i in self.kv_quantize_skip_layers):
+            raise ValueError(
+                f"kv_quantize_skip_layers {self.kv_quantize_skip_layers} out of range "
+                f"for {config.num_layers} layers"
+            )
         if self.paged:
             from unionml_tpu.models.gpt import block_table_width
             from unionml_tpu.serving.prefix_cache import PrefixCache
@@ -637,10 +664,12 @@ class DecodeEngine:
         self._save_fn = jax.jit(_save, static_argnums=(5,), donate_argnums=(0,))
 
         if self.paged:
-            block_size = self._prefix_block_size
-            # retired rows' positions park on the trailing scratch column:
-            # >= (width-1)*block_size maps every masked write to table[:, -1]
-            sentinel = (self._table_width - 1) * block_size
+            # The paged programs below read self._prefix_block_size /
+            # self._table_width at TRACE time, never as __init__-captured
+            # locals: enable_prefix_cache can re-lay-out the pool after
+            # construction, and any block-size/width change alters the pool
+            # leaf and table shapes, forcing every jitted paged program to
+            # retrace — which is exactly when the fresh values are re-read.
 
             def _decode_body_paged(
                 variables, pool, tables, last_logits, lens, active, key, temp, top_k, top_p,
@@ -663,8 +692,11 @@ class DecodeEngine:
                     tokens = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
                 # a retired row still scatters one K/V column per step (the
                 # program is unmasked); aiming its position at the sentinel
-                # sends that write to scratch, so a freed block can be re-owned
-                # by another slot without this row's stale table corrupting it
+                # (>= (width-1)*block_size maps every masked write to
+                # table[:, -1], the trailing scratch column) sends that write
+                # to scratch, so a freed block can be re-owned by another slot
+                # without this row's stale table corrupting it
+                sentinel = (self._table_width - 1) * self._prefix_block_size
                 pos = jnp.where(active, lens, sentinel)
                 cache = {"table": tables, **pool}
                 logits, new_cache = model.apply(variables, tokens[:, None], cache=cache, position=pos)
@@ -709,18 +741,55 @@ class DecodeEngine:
                 """Scatter a batched bucket prefill's dense workspace into the
                 admitted slots' pool blocks through their table rows. Padded
                 columns past a slot's allocation map to scratch (the rows'
-                unmapped tail), so the scatter needs no per-row length mask."""
+                unmapped tail), so the full-precision scatter needs no per-row
+                length mask. Quantized layers DO mask: a padded column landing
+                in an owned block must not inflate that block's absmax scale,
+                so positions at/after a row's real length quantize as zeros."""
+                # graftlint: disable=retrace -- deliberate trace-time read: block_size is an axis of every pool leaf and fixes the table width, so any host mutation (enable_prefix_cache re-layout) changes this program's input shapes and forces the retrace that re-reads it
+                block_size = self._prefix_block_size
                 rows_tables = tables[slots]  # (rows, width)
                 bucket = jax.tree_util.tree_leaves(local_cache)[0].shape[2]
                 cols = jnp.arange(bucket)
                 blk, off = cols // block_size, cols % block_size
                 dst = rows_tables[:, blk]  # (rows, bucket)
+                nb = -(-bucket // block_size)
+                dst_blocks = rows_tables[:, :nb]  # (rows, nb)
+                pad = nb * block_size - bucket
+                valid = (
+                    jnp.arange(nb * block_size).reshape(nb, block_size)[None, :, :]
+                    < lengths[:, None, None]
+                )  # (rows, nb, bs)
 
-                def put(pool_leaf, local_leaf):
+                def put_full(pool_leaf, local_leaf):
                     src = jnp.moveaxis(local_leaf, 2, 1).astype(pool_leaf.dtype)
                     return pool_leaf.at[dst, :, off[None, :], :].set(src)
 
-                pool = _constrain_cache(jax.tree_util.tree_map(put, pool, local_cache))
+                def put_quantized(pool_q, pool_scale, local_leaf):
+                    from unionml_tpu.ops.quant import quantize_blockwise
+
+                    rows, heads, _, head_dim = local_leaf.shape
+                    src = local_leaf.astype(jnp.float32)
+                    if pad:
+                        src = jnp.pad(src, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                    # (rows, nb, heads, bs, hd): block layout, padded tail zeroed
+                    src = src.reshape(rows, heads, nb, block_size, head_dim).transpose(0, 2, 1, 3, 4)
+                    src = jnp.where(valid[:, :, None, :, None], src, 0.0)
+                    q, scale = quantize_blockwise(src, reduce_axes=(3, 4))
+                    return pool_q.at[dst_blocks].set(q), pool_scale.at[dst_blocks].set(scale)
+
+                new_pool = {}
+                for name, layer in pool.items():
+                    local = local_cache[name]
+                    if "k_scale" in layer:
+                        out = {}
+                        for key in ("k", "v"):
+                            out[key], out[key + "_scale"] = put_quantized(
+                                layer[key], layer[key + "_scale"], local[key]
+                            )
+                        new_pool[name] = out
+                    else:
+                        new_pool[name] = {key: put_full(layer[key], local[key]) for key in ("k", "v")}
+                pool = _constrain_cache(new_pool)
                 return (
                     pool,
                     lens.at[slots].set(lengths.astype(lens.dtype)),
@@ -782,7 +851,13 @@ class DecodeEngine:
 
         if self.paged:
             self._cache = None
-            pool = init_block_pool(self._config, self.pool_blocks, self._prefix_block_size)
+            pool = init_block_pool(
+                self._config,
+                self.pool_blocks,
+                self._prefix_block_size,
+                kv_quantize=self.kv_quantize,
+                kv_quantize_skip_layers=self.kv_quantize_skip_layers,
+            )
             tables = init_block_tables(
                 self.num_slots, self.max_len, self._prefix_block_size, self._scratch_block
             )
@@ -1050,6 +1125,28 @@ class DecodeEngine:
         self._telemetry.pool_live_blocks.set(float(stats["slot_blocks"]))
         self._telemetry.pool_cached_blocks.set(float(stats["cached_blocks"]))
         self._telemetry.pool_pinned_blocks.set(float(stats["pinned_blocks"]))
+        kv = self.kv_pool_stats()
+        if kv:  # {} on dense engines / before the pool exists
+            self._telemetry.pool_kv_bytes.set(float(kv["kv_pool_bytes"]), kv["kv_dtype"])
+            self._telemetry.pool_kv_bytes_dense_equiv.set(float(kv["kv_pool_bytes_dense_equiv"]))
+
+    def kv_pool_stats(self) -> Dict[str, Any]:
+        """Byte accounting of the resident KV pool layout (shapes only — no
+        device sync): ``kv_dtype`` (what crosses HBM per decode gather),
+        ``kv_pool_bytes`` (as stored, scale arrays included) and
+        ``kv_pool_bytes_dense_equiv`` (the same positions priced at the full
+        compute dtype — what capacity dashboards compare against). Empty on
+        dense engines (their per-slot caches are not pool-accounted)."""
+        if not self.paged or self._pool is None:
+            return {}
+        from unionml_tpu.models.gpt import kv_pool_bytes
+
+        stored, full = kv_pool_bytes(self._pool, self._config.dtype)
+        return {
+            "kv_dtype": self.kv_quantize or str(jnp.dtype(self._config.dtype).name),
+            "kv_pool_bytes": stored,
+            "kv_pool_bytes_dense_equiv": full,
+        }
 
     def _write_slot_row(self, slot: int, block_ids: Sequence[int]) -> None:
         """Upload one slot's block-table row: shared spliced prefix ids first,

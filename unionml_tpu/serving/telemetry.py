@@ -251,6 +251,19 @@ class Telemetry:
         self.pool_pinned_blocks = m.gauge(
             "unionml_kv_pool_pinned_blocks", "Paged KV pool blocks pinned by preempt/salvage checkpoints"
         )
+        # pool byte footprint (ISSUE 14): the kv_dtype label says what actually
+        # crosses HBM ("int8" under kv_quantize, else the compute dtype), and
+        # the dense-equivalent gauge prices the same KV positions at full
+        # precision — their ratio is the capacity doubling on dashboards
+        self.pool_kv_bytes = m.gauge(
+            "unionml_kv_pool_bytes",
+            "Paged KV pool resident bytes as stored (scale arrays included)",
+            ("kv_dtype",),
+        )
+        self.pool_kv_bytes_dense_equiv = m.gauge(
+            "unionml_kv_pool_bytes_dense_equiv",
+            "Same KV pool positions priced at the full compute dtype",
+        )
         self.blocks_per_request = m.histogram(
             "unionml_kv_blocks_per_request",
             "Pool blocks allocated per admitted request (paged engines)",
