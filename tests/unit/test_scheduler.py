@@ -171,6 +171,25 @@ def test_take_expired_removes_and_counts():
     assert sched.pop(10, now=now) == [live]
 
 
+def test_load_signal_and_stats_carry_the_pool_block():
+    """The ``"pool"`` block (ISSUE 15): ``None`` without a provider (dense
+    engines), else forwarded verbatim in BOTH load_signal (router +
+    autoscaler surface) and stats (the ``/stats`` scheduler block)."""
+    sched = SLOScheduler(SchedulerConfig())
+    assert sched.load_signal()["pool"] is None
+    assert sched.stats()["pool"] is None
+    occupancy = {
+        "num_blocks": 64, "free_frac": 0.5, "live_frac": 0.25,
+        "cached_frac": 0.25, "pinned_frac": 0.0,
+        "available_blocks": 48, "pressure": 0.25,
+    }
+    sched.pool_signal = lambda: occupancy
+    signal = sched.load_signal()
+    assert signal["pool"] == occupancy
+    assert set(signal) == {"depth", "queue_wait_ema_ms", "per_class", "pool"}
+    assert sched.stats()["pool"] == occupancy
+
+
 # ------------------------------------------------ engine preempt / resume
 
 
@@ -365,6 +384,36 @@ def test_preempt_to_prefix_cache_end_to_end(gpt, gpt_tiny_solo):
     assert stats["preemptions"] >= 1 and stats["resumes"] >= 1
     assert engine.preempted_requests >= 1
     assert engine.prefix_cache.pinned_blocks == 0
+
+
+def test_batcher_wires_engine_pool_signal_into_scheduler(gpt):
+    """A paged batcher hands the engine's pool-occupancy provider to its
+    scheduler, so load_signal/stats surface the block-pool counters; a
+    dense engine has no pool and the block stays None."""
+    model, variables = gpt
+    batcher = ContinuousBatcher(_engine(model, variables))
+    try:
+        pool = batcher.scheduler.load_signal()["pool"]
+        assert set(pool) == {
+            "num_blocks", "free_frac", "live_frac", "cached_frac",
+            "pinned_frac", "available_blocks", "pressure",
+        }
+        # idle engine: everything free, nothing live/pinned, zero pressure
+        assert pool["free_frac"] == 1.0 and pool["pressure"] == 0.0
+        assert pool["available_blocks"] == pool["num_blocks"] > 0
+        assert pool["live_frac"] == 0.0 and pool["pinned_frac"] == 0.0
+        assert batcher.scheduler.stats()["pool"] == pool
+    finally:
+        batcher.close()
+
+    dense = ContinuousBatcher(DecodeEngine(
+        model, variables, num_slots=2, max_len=64, prefill_buckets=(8, 16, 32),
+        paged=False,
+    ))
+    try:
+        assert dense.scheduler.load_signal()["pool"] is None
+    finally:
+        dense.close()
 
 
 def test_preempt_racing_disconnect_never_leaks_pinned_entry(gpt, gpt_tiny_solo):

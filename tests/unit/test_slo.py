@@ -1,0 +1,118 @@
+"""Per-class SLO arithmetic (ISSUE 15): attainment, burn rate, alerting.
+
+The tracker is the ONE definition of "meeting the SLO" shared by the live
+``/metrics`` + ``/stats`` surface and the fleet simulator's replay, so the
+arithmetic pinned here — good/bad accounting, rolling-window pruning,
+burn = bad_fraction / error_budget, and the multi-window alert — is the
+contract both sides score against.
+"""
+
+import pytest
+
+from unionml_tpu.serving.slo import (
+    DEFAULT_WINDOWS,
+    SLOConfig,
+    SLOObjective,
+    SLOTracker,
+)
+
+
+def _config(**kw):
+    kw.setdefault(
+        "objectives",
+        {
+            "interactive": SLOObjective(ttft_ms=100.0, target=0.9),
+            "standard": SLOObjective(ttft_ms=500.0, target=0.5),
+            "batch": SLOObjective(ttft_ms=None, target=0.5),
+        },
+    )
+    kw.setdefault("windows", (("10s", 10.0), ("60s", 60.0)))
+    return SLOConfig(**kw)
+
+
+def test_objective_and_config_validation():
+    with pytest.raises(ValueError):
+        SLOObjective(ttft_ms=100.0, target=1.0)  # target must be < 1
+    with pytest.raises(ValueError):
+        SLOObjective(ttft_ms=0.0, target=0.9)  # bound must be positive
+    with pytest.raises(ValueError):
+        SLOConfig(windows=())
+    with pytest.raises(ValueError):
+        SLOConfig(objectives={"interactive": SLOObjective(250.0, 0.99)})  # no standard
+    assert SLOConfig().windows == DEFAULT_WINDOWS
+
+
+def test_good_bad_accounting_and_fallback_class():
+    tracker = SLOTracker(_config())
+    assert tracker.record("interactive", "ok", 80.0, now=0.0)["attainment"] == 1.0
+    tracker.record("interactive", "ok", 150.0, now=1.0)  # over the TTFT bound: bad
+    tracker.record("interactive", "shed", None, now=2.0)  # sheds are bad
+    tracker.record("batch", "ok", 10_000.0, now=3.0)  # no bound: any ok is good
+    tracker.record("batch", "error", None, now=4.0)
+    assert tracker.record("interactive", "cancelled", None, now=5.0) is None  # excluded
+    # a class with no configured objective scores against "standard"
+    tracker.record("mystery", "ok", 400.0, now=6.0)
+    tracker.record("mystery", "ok", 600.0, now=7.0)
+    assert tracker.totals() == {
+        "batch": {"good": 1, "total": 2},
+        "interactive": {"good": 1, "total": 3},
+        "mystery": {"good": 1, "total": 2},
+    }
+    report = tracker.report(now=8.0)
+    assert report["per_class"]["mystery"]["objective_ttft_ms"] == 500.0
+    assert report["per_class"]["interactive"]["attainment"] == round(1 / 3, 6)
+
+
+def test_boundary_ttft_is_good_at_journal_precision():
+    # TTFT is journaled at 3 decimals; the comparison is <= so a request
+    # exactly on the bound meets it — live and replay agree on the boundary
+    tracker = SLOTracker(_config())
+    assert tracker.record("interactive", "ok", 100.0, now=0.0)["attainment"] == 1.0
+    assert tracker.record("interactive", "ok", 100.001, now=0.1)["attainment"] == 0.5
+
+
+def test_rolling_window_prune_and_burn_rate():
+    tracker = SLOTracker(_config())
+    # error budget for interactive is 1 - 0.9 = 0.1; one bad out of two in
+    # the window burns at (0.5 bad fraction) / 0.1 = 5x sustainable
+    tracker.record("interactive", "ok", 50.0, now=0.0)
+    signal = tracker.record("interactive", "shed", None, now=1.0)
+    assert signal["burn"] == {"10s": 5.0, "60s": 5.0}
+    # 12s later the 10s window has forgotten both events; the 60s window
+    # still carries them (prune happens on read, via report)
+    report = tracker.report(now=13.0)
+    windows = report["per_class"]["interactive"]["windows"]
+    assert windows["10s"]["total"] == 0 and windows["10s"]["attainment"] is None
+    assert windows["60s"]["total"] == 2 and windows["60s"]["burn_rate"] == 5.0
+    # lifetime totals never prune
+    assert report["per_class"]["interactive"]["total"] == 2
+
+
+def test_multi_window_alert_needs_every_window_burning():
+    tracker = SLOTracker(_config(alert_burn=2.0))
+    # a burst of bads inside the short window only: short window burns hot,
+    # long window is padded with enough goods to stay under the threshold
+    for i in range(20):
+        tracker.record("interactive", "ok", 50.0, now=float(i))
+    for i in range(4):
+        tracker.record("interactive", "shed", None, now=55.0 + i)
+    report = tracker.report(now=59.0)
+    windows = report["per_class"]["interactive"]["windows"]
+    assert windows["10s"]["burn_rate"] >= 2.0  # current
+    assert windows["60s"]["burn_rate"] < 2.0  # not yet material
+    assert report["per_class"]["interactive"]["alert"] is False
+    assert report["alerts"] == []
+    # keep shedding until the long window burns too -> page
+    for i in range(10):
+        tracker.record("interactive", "shed", None, now=60.0 + i)
+    report = tracker.report(now=70.0)
+    assert report["per_class"]["interactive"]["alert"] is True
+    assert report["alerts"] == ["interactive"]
+
+
+def test_empty_tracker_report_shape():
+    tracker = SLOTracker()
+    report = tracker.report(now=0.0)
+    assert report["per_class"] == {} and report["alerts"] == []
+    assert report["windows"] == {"5m": 300.0, "1h": 3600.0}
+    assert tracker.totals() == {}

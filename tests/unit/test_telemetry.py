@@ -244,23 +244,35 @@ def test_ring_bounds_and_recent_order():
     assert tel.stats()["completed_traces"] == 3  # counter outlives the ring
 
 
-def test_journal_jsonl_sink_schema_v1(tmp_path):
+def test_journal_jsonl_sink_schema_v2(tmp_path):
     path = tmp_path / "journal.jsonl"
     tel = Telemetry(journal_path=str(path))
     for name, status, reason in [("ra", "ok", None), ("rb", "shed", "queue_full")]:
-        tel.new_trace(name)
+        tel.new_trace(name, session_id="sess-7" if name == "ra" else None)
+        tel.span(name, "admission", block_demand=4, available_blocks=64)
         tel.note_tokens_in(name, 4)
         tel.end_trace(name, status, reason=reason)
     lines = path.read_text().splitlines()
     assert len(lines) == 2
     records = [json.loads(line) for line in lines]
     for rec in records:
-        assert rec["v"] == JOURNAL_SCHEMA_VERSION
+        assert rec["v"] == JOURNAL_SCHEMA_VERSION == 2
         assert set(rec) >= {
             "request_id", "created_unix", "class", "status",
             "tokens_in", "tokens_out", "decode_bursts", "spans",
         }
+        # v2: the admission span journals the pool arithmetic the batcher
+        # gated on, so a simulator replay needs no side channels
+        admission = next(s for s in rec["spans"] if s["kind"] == "admission")
+        assert admission["attrs"]["block_demand"] == 4
+        assert admission["attrs"]["available_blocks"] == 64
     assert records[0]["request_id"] == "ra" and records[0]["status"] == "ok"
+    # v2: session id lands top-level AND on the admission span (the replay
+    # loader reads either); a sessionless request journals neither
+    assert records[0]["session_id"] == "sess-7"
+    admission = next(s for s in records[0]["spans"] if s["kind"] == "admission")
+    assert admission["attrs"]["session_id"] == "sess-7"
+    assert "session_id" not in records[1]
     assert records[1]["status"] == "shed" and records[1]["reason"] == "queue_full"
 
 
@@ -296,6 +308,12 @@ def test_batcher_end_to_end_trace_and_metrics(gpt, gpt_tiny_solo):
     assert "# TYPE unionml_ttft_ms histogram" in text
     assert 'unionml_requests_total{outcome="ok"} 1' in text
     assert "unionml_decode_fetch_ms_bucket" in text
+    # SLO surface (ISSUE 15): one on-time ok request -> full attainment,
+    # zero burn in every configured window — golden exposition lines
+    assert "# TYPE unionml_slo_attainment gauge" in text
+    assert 'unionml_slo_attainment{cls="standard"} 1' in text
+    assert 'unionml_slo_burn_rate{cls="standard",window="5m"} 0' in text
+    assert 'unionml_slo_burn_rate{cls="standard",window="1h"} 0' in text
 
 
 def test_decode_with_telemetry_is_transfer_guard_clean(gpt):
@@ -445,6 +463,7 @@ def test_http_metrics_trace_and_request_id_echo(gpt):
             assert "# TYPE unionml_requests_total counter" in text
             assert 'unionml_requests_total{outcome="ok"} 1' in text
             assert "unionml_ttft_ms_bucket" in text
+            assert 'unionml_slo_attainment{cls="standard"}' in text
 
             trace = await (await client.get(f"/trace/{rid}")).json()
             assert trace["request_id"] == rid and trace["status"] == "ok"
@@ -457,6 +476,13 @@ def test_http_metrics_trace_and_request_id_echo(gpt):
             stats = await (await client.get("/stats")).json()
             assert stats["telemetry"]["completed_traces"] == 1
             assert stats["telemetry"]["metrics"]["unionml_tokens_out_total"] == 6.0
+            # generation.slo: the per-class attainment + burn-rate report,
+            # identical solo/fleet (same SLOTracker behind /metrics gauges)
+            slo = stats["generation"]["slo"]
+            assert set(slo) == {"windows", "alert_burn", "per_class", "alerts"}
+            standard = slo["per_class"]["standard"]
+            assert standard["total"] == 1
+            assert set(standard["windows"]) == set(slo["windows"])
 
             resp = await client.get("/trace/deadbeef00000000")
             assert resp.status == 404

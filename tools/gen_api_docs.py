@@ -37,6 +37,8 @@ MODULES = [
     ("unionml_tpu.serving.fleet", "Fleet serving tier"),
     ("unionml_tpu.serving.telemetry", "Serving telemetry (traces & journal)"),
     ("unionml_tpu.serving.metrics", "Metrics registry & Prometheus exposition"),
+    ("unionml_tpu.serving.slo", "SLO objectives, attainment & burn rate"),
+    ("unionml_tpu.sim", "Fleet simulator (replay, synthetic traces, autoscaler)"),
     ("unionml_tpu.ops.attention", "Attention ops"),
     ("unionml_tpu.ops.sampling", "Sampling ops"),
     ("unionml_tpu.ops.quant", "Quantization ops"),

@@ -26,7 +26,7 @@ LOCKFILE=.tpu_window.lock
 exec 9>"$LOCKFILE"
 if ! flock -n 9; then
   echo "$STAMP tpu_window.sh: another battery holds $LOCKFILE; aborting" >> TPU_PROBES.log
-  exit 3  # exit codes: 0 battery ok, 1 bench failed, 2 tunnel not live, 3 lock held, 4 lint findings
+  exit 3  # exit codes: 0 battery ok, 1 bench failed, 2 tunnel not live, 3 lock held, 4 lint findings, 5 sim gate failed
 fi
 
 # graftlint gate (CPU-only, no tunnel needed): refuse to spend a TPU window
@@ -40,13 +40,25 @@ fi
 # so a slow linter can never eat the tunnel window it exists to protect.
 if ! timeout 120 env JAX_PLATFORMS=cpu python -m unionml_tpu.analysis \
     unionml_tpu tools tests bench.py bench_int8.py bench_kernels.py \
-    bench_mfu.py bench_packing.py bench_serving.py bench_util.py \
+    bench_mfu.py bench_packing.py bench_serving.py bench_sim.py bench_util.py \
     --baseline tools/graftlint_baseline.json \
     --sarif /tmp/tpu_lint.sarif --budget 10 --fail-on-findings \
     > /tmp/tpu_lint.out 2>&1; then
   echo "$STAMP tpu_window.sh: graftlint findings; aborting battery (see /tmp/tpu_lint.out, /tmp/tpu_lint.sarif)" >> TPU_PROBES.log
   exit 4
 fi
+
+# CPU-side fleet-sim battery (no tunnel needed): push 1e5 synthetic users
+# through the REAL router/scheduler/block-demand stack and gate that the
+# autoscaler beats static provisioning on attainment-per-replica. The sim is
+# pure host arithmetic, so SIM_BENCH_cpu.json is the canonical committed
+# artifact (gitignore exception) — a gate failure means the autoscaler or the
+# admission arithmetic regressed, which invalidates the fleet phases below.
+if ! timeout 180 env JAX_PLATFORMS=cpu python bench_sim.py > /tmp/tpu_sim.out 2>&1; then
+  echo "$STAMP tpu_window.sh: bench_sim gate FAILED; aborting battery (see /tmp/tpu_sim.out)" >> TPU_PROBES.log
+  exit 5
+fi
+echo "$STAMP tpu_window.sh: bench_sim OK: $(tail -1 /tmp/tpu_sim.out)" >> TPU_PROBES.log
 
 if ! timeout 60 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
   echo "$STAMP tpu_window.sh: tunnel not live; aborting" >> TPU_PROBES.log

@@ -9,6 +9,7 @@ from unionml_tpu.serving.fleet import EngineFleet, FleetConfig, Router, split_me
 from unionml_tpu.serving.metrics import MetricsRegistry
 from unionml_tpu.serving.prefix_cache import PrefixCache
 from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
+from unionml_tpu.serving.slo import SLOConfig, SLOObjective, SLOTracker
 from unionml_tpu.serving.speculative import SpeculativeBatcher
 from unionml_tpu.serving.supervisor import EngineSupervisor
 from unionml_tpu.serving.telemetry import Telemetry
@@ -75,7 +76,10 @@ __all__ = [
     "PrefixCache",
     "ResidentPredictor",
     "Router",
+    "SLOConfig",
+    "SLOObjective",
     "SLOScheduler",
+    "SLOTracker",
     "SchedulerConfig",
     "Telemetry",
     "split_mesh",

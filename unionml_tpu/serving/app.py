@@ -781,6 +781,11 @@ def build_aiohttp_app(
             # Prometheus /metrics endpoint renders), so a client reads one
             # block whichever deployment shape is behind the route
             payload["telemetry"] = {**tel.stats(), "metrics": tel.metrics.snapshot()}
+            if "generation" in payload and getattr(tel, "slo", None) is not None:
+                # per-class SLO attainment + multi-window burn rate, identical
+                # solo/fleet (the tracker sits on the shared Telemetry, above
+                # whichever generator shape feeds it)
+                payload["generation"]["slo"] = tel.slo.report()
         if batcher is not None:
             payload["coalescing"] = dict(batcher.stats)
             if batcher.ema_gap_ms is not None:

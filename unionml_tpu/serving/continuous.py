@@ -95,6 +95,18 @@ from unionml_tpu.serving.faults import EngineFailure, FaultPlan
 DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512)
 
 
+def block_demand(prompt_len: int, budget: int, *, max_len: int, block_size: int) -> int:
+    """Pool blocks one request needs for its whole lifetime: prompt plus
+    budget, capped at cache capacity, rounded up to whole blocks.
+
+    This is THE paged-admission arithmetic, split out as a pure function so
+    the fleet simulator (``unionml_tpu.sim``) gates its virtual admissions on
+    the identical math the live batcher uses —
+    :meth:`DecodeEngine.block_demand` delegates here."""
+    need = min(int(prompt_len) + int(budget), int(max_len))
+    return -(-need // int(block_size))
+
+
 @dataclasses.dataclass(frozen=True)
 class StepEvent:
     """One slot's outcome for one engine step."""
@@ -1063,8 +1075,9 @@ class DecodeEngine:
         so it is the CONSERVATIVE demand the batcher gates on."""
         if not self.paged:
             return 0
-        need = min(int(prompt_len) + int(budget), self.max_len)
-        return -(-need // self._prefix_block_size)
+        return block_demand(
+            prompt_len, budget, max_len=self.max_len, block_size=self._prefix_block_size
+        )
 
     def available_blocks(self) -> Optional[int]:
         """Blocks an admission could allocate right now — the free list plus
@@ -1073,6 +1086,36 @@ class DecodeEngine:
         if not self.paged:
             return None
         return self._allocator.available_blocks()
+
+    def pool_signal(self) -> Optional[Dict[str, Any]]:
+        """Counter-derived block-pool occupancy for the scheduler's
+        :meth:`~unionml_tpu.serving.scheduler.SLOScheduler.load_signal`
+        (fleet routing + autoscaling): ``None`` on dense engines, else
+        ``num_blocks``, the free/live/cached/pinned fractions,
+        ``available_blocks`` (free plus cached-minus-pinned — an upper
+        bound on what eviction could reclaim), and ``pressure`` (1 minus
+        the available fraction). Plain counter reads only — the EXACT
+        evictable-chain walk (:meth:`available_blocks`) stays on the
+        worker-thread admission path, because it traverses the radix tree
+        this signal must not race with."""
+        if not self.paged:
+            return None
+        stats = self._allocator.stats()
+        total = max(1, int(stats["num_blocks"]))
+        free = int(stats["free_blocks"])
+        live = int(stats["slot_blocks"])
+        cached = int(stats["cached_blocks"])
+        pinned = int(stats["pinned_blocks"])
+        available = max(0, min(total, free + cached - pinned))
+        return {
+            "num_blocks": total,
+            "free_frac": round(free / total, 4),
+            "live_frac": round(live / total, 4),
+            "cached_frac": round(cached / total, 4),
+            "pinned_frac": round(pinned / total, 4),
+            "available_blocks": available,
+            "pressure": round(1.0 - available / total, 4),
+        }
 
     # transfers: kv-block
     def _alloc_slot_blocks(self, slot: int, start: int, need: int) -> List[int]:
@@ -2814,6 +2857,10 @@ class ContinuousBatcher:
         )
         if self._telemetry is not None and getattr(self.scheduler, "_telemetry", None) is None:
             self.scheduler._telemetry = self._telemetry
+        # one signal dict for router + autoscaler: the scheduler's load_signal
+        # carries the paged pool's occupancy next to the queue-wait EMAs
+        if getattr(self.scheduler, "pool_signal", None) is None:
+            self.scheduler.pool_signal = engine.pool_signal
         #: slot -> sink; worker-thread-only by design (admission fan-out and
         #: event dispatch both run on the worker), so no guard is declared
         self._sinks: Dict[int, Any] = {}
@@ -2898,11 +2945,20 @@ class ContinuousBatcher:
                 request_id, cls=class_name(ticket.priority)
             )
             telemetry.note_tokens_in(ticket.request_id, int(prompt.size))
+            pool_sig = self._engine.pool_signal()
             telemetry.span(
                 ticket.request_id, "admission",
                 prompt_tokens=int(prompt.size), budget=int(max_new_tokens),
                 cls=class_name(ticket.priority),
                 deadline_ms=deadline_ms,
+                # journal v2: the pool arithmetic at admission time, so a
+                # simulator replay needs no side channels (0 / None on dense)
+                block_demand=self._engine.block_demand(
+                    int(prompt.size), int(max_new_tokens)
+                ),
+                available_blocks=(
+                    None if pool_sig is None else pool_sig["available_blocks"]
+                ),
             )
         try:
             with self._lock:

@@ -331,6 +331,41 @@ class Router:
             entry = self._sessions.get(session_id)
             return None if entry is None else entry[0]
 
+    # ------------------------------------------------------ autoscaler warm-up
+
+    def hot_digests(self, k: int = 128) -> List[int]:
+        """The fleet's ``k`` hottest prefix digests, most recent first —
+        drawn round-robin from the tail of every replica's LRU index (the
+        tail IS recency). The autoscaler feeds these to
+        :meth:`warm_replica` so a scaled-up replica starts with the radix
+        paths traffic is actually hitting instead of a cold index that
+        repels every affinity score."""
+        if k < 1:
+            return []
+        with self._lock:
+            tails = [list(reversed(held)) for held in self._digests if held]
+            out: List[int] = []
+            seen = set()
+            for rank in range(max((len(t) for t in tails), default=0)):
+                for tail in tails:
+                    if rank < len(tail) and tail[rank] not in seen:
+                        seen.add(tail[rank])
+                        out.append(tail[rank])
+                        if len(out) >= k:
+                            return out
+            return out
+
+    def warm_replica(self, index: int, digests: Sequence[int]) -> None:
+        """Seed ``index``'s digest index (scale-up warm-up): recorded
+        oldest-first so the hottest digest (``digests[0]``, per
+        :meth:`hot_digests` ordering) lands most-recent in the LRU. The
+        replica's radix cache is still cold — the first routed request per
+        prefix pays one prefill, after which the advertised affinity is
+        real; without seeding, a cold index repels exactly the traffic that
+        would warm it."""
+        with self._lock:
+            self._record(int(index), list(reversed(list(digests))))
+
     def stats(self) -> Dict[str, Any]:
         """The ``/stats`` → ``generation.fleet.router`` block."""
         with self._lock:
@@ -532,6 +567,12 @@ class EngineFleet:
             slots = max(1, int(getattr(rep.engine, "num_slots", 1)))
             ema_ms = signal.get("queue_wait_ema_ms") or 0.0
             load = (signal["depth"] + rep.engine.num_active) / slots + ema_ms / 1e3
+            pool = signal.get("pool")
+            if pool:
+                # paged engines: a replica whose block pool is nearly
+                # unreclaimable is as unattractive as a full slot table,
+                # whatever its queue says (admission will head-of-line block)
+                load += float(pool.get("pressure", 0.0))
             out.append((rep.index, weight, load))
         return out
 
@@ -609,7 +650,7 @@ class EngineFleet:
             # open the trace BEFORE routing so the route/shed spans land on it;
             # the replica batcher joins it (new_trace is idempotent on an
             # active request_id)
-            request_id = self._telemetry.new_trace(request_id)
+            request_id = self._telemetry.new_trace(request_id, session_id=session_id)
             replica = self._route(prompt_ids, session_id, request_id)
         else:
             # two-arg call kept for telemetry-less fleets (wrappable in tests)
@@ -634,7 +675,7 @@ class EngineFleet:
         the first ``__anext__``, before any token, like the single-engine
         path)."""
         if self._telemetry is not None:
-            request_id = self._telemetry.new_trace(request_id)
+            request_id = self._telemetry.new_trace(request_id, session_id=session_id)
             replica = self._route(prompt_ids, session_id, request_id)
         else:
             replica = self._route(prompt_ids, session_id)

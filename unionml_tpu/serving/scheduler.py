@@ -45,7 +45,7 @@ control arm of the ``bench_serving.py --slo-mix`` A/B.
 import dataclasses
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 __all__ = [
     "PRIORITY_CLASSES",
@@ -205,6 +205,11 @@ class SLOScheduler:
         self.config = config or SchedulerConfig()
         #: optional Telemetry; every record site is OUTSIDE _lock (lock-leaf)
         self._telemetry = telemetry
+        #: optional zero-arg provider of the engine's block-pool occupancy
+        #: (``DecodeEngine.pool_signal``; None on dense engines). Set by the
+        #: owning batcher before traffic, like ``_telemetry`` — and invoked
+        #: OUTSIDE ``_lock`` so the scheduler lock stays a leaf.
+        self.pool_signal: Optional[Callable[[], Optional[Dict[str, Any]]]] = None
         self._lock = threading.Lock()
         self._queued: List[Ticket] = []  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
@@ -463,21 +468,33 @@ class SLOScheduler:
             return len(self._queued)
 
     def load_signal(self) -> Dict[str, Any]:
-        """The routing signal a fleet router reads per candidate replica:
-        queue depth plus the global and per-class queue-wait EMAs, taken in
-        one lock hold so the numbers are mutually consistent. Cheap enough
-        to call on every route decision (host ints/floats only)."""
+        """The ONE signal dict the fleet router and the autoscaler score
+        from: queue depth plus the global and per-class queue-wait EMAs
+        (taken in one lock hold so the numbers are mutually consistent),
+        and — when the owning batcher wired a paged engine's
+        ``pool_signal`` provider — the block-pool occupancy under
+        ``"pool"`` (``num_blocks`` plus ``free``/``live``/``cached``/
+        ``pinned`` fractions, ``available_blocks``, and the scalar
+        ``pressure``; ``None`` on dense engines). The provider is called
+        BEFORE the scheduler lock is taken (both locks stay leaves). Cheap
+        enough to call on every route decision (host ints/floats only)."""
+        provider = self.pool_signal
+        pool = provider() if provider is not None else None
         with self._lock:
             return {
                 "depth": len(self._queued),
                 "queue_wait_ema_ms": self.queue_wait_ema_ms,
                 "per_class": dict(self.queue_wait_ema_ms_by_class),
+                "pool": pool,
             }
 
     def stats(self) -> Dict[str, Any]:
         """The ``GET /stats`` → ``generation.scheduler`` block: per-class
         queue depth, queue-wait EMA, shed / preemption / deadline-miss
-        counters, and the configured policy."""
+        counters, the configured policy, and (paged engines) the same
+        ``pool`` occupancy block :meth:`load_signal` carries."""
+        provider = self.pool_signal
+        pool = provider() if provider is not None else None
         with self._lock:
             depth_by_class = {name: 0 for name in PRIORITY_CLASSES}
             for ticket in self._queued:
@@ -502,4 +519,5 @@ class SLOScheduler:
                 "deadline_misses_running": self.deadline_misses_running,
                 "preemptions": self.preemptions,
                 "resumes": self.resumes,
+                "pool": pool,
             }

@@ -8,8 +8,10 @@ on the engine's existing fused deferred fetches: ZERO new host↔device
 syncs, pinned by the transfer-guard regression), preemption/resume,
 quarantine, engine death, and failover adoption. Completed traces land in a
 bounded ring journal (``/traces/recent``, ``/trace/{request_id}``) and
-optionally a JSONL sink whose schema (v1, see ``docs/observability.md``)
-is the replay input format for the ROADMAP-8 fleet simulator.
+optionally a JSONL sink whose schema (v2, see ``docs/observability.md``)
+is the replay input format for the fleet simulator (``unionml_tpu.sim``):
+v2 stamps the session id and the admission-time block-pool arithmetic onto
+every trace so replay needs no side channels.
 
 Hook contract (the PR-7 FaultPlan pattern): every emitting module holds an
 ``Optional[Telemetry]`` and guards each record site with a single host
@@ -35,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional
 
 from unionml_tpu.serving.metrics import MetricsRegistry, log_buckets
+from unionml_tpu.serving.slo import SLOTracker
 
 __all__ = [
     "JOURNAL_SCHEMA_VERSION",
@@ -43,8 +46,12 @@ __all__ = [
     "Trace",
 ]
 
-#: bump when the journal JSONL schema changes shape (simulator replay input)
-JOURNAL_SCHEMA_VERSION = 1
+#: bump when the journal JSONL schema changes shape (simulator replay input).
+#: v2 (ISSUE 15): top-level ``session_id``; admission spans carry
+#: ``block_demand`` + ``available_blocks``; admission/queue_wait spans carry
+#: the session id. The sim's loader (``unionml_tpu.sim.journal``) still
+#: accepts v1 with those fields defaulted.
+JOURNAL_SCHEMA_VERSION = 2
 
 #: latency bucket bounds, ms: 0.25 ms … ~16 s in ×2 steps (17 buckets)
 _LATENCY_BUCKETS_MS = log_buckets(0.25, 2.0, 17)
@@ -86,6 +93,7 @@ class Trace:
     request_id: str
     created_unix: float
     t0: float  # monotonic origin for every span's t_ms
+    session_id: Optional[str] = None
     cls: str = "standard"
     status: str = "active"
     reason: Optional[str] = None
@@ -121,6 +129,8 @@ class Trace:
             "decode_bursts": self.decode_bursts,
             "spans": [s.to_dict() for s in self.spans],
         }
+        if self.session_id is not None:
+            out["session_id"] = self.session_id
         if self.reason is not None:
             out["reason"] = self.reason
         ttft = self.ttft_ms
@@ -152,6 +162,10 @@ class Telemetry:
         per line — the ROADMAP-8 simulator's replay input.
     :param max_spans: per-trace span cap; beyond it spans are dropped and
         counted in ``attrs["spans_dropped"]`` (bounds runaway requests).
+    :param slo: shared :class:`~unionml_tpu.serving.slo.SLOTracker`; a fresh
+        default-objective tracker is created when omitted, so every deployment
+        shape gets the ``/metrics`` attainment/burn gauges and the
+        ``generation.slo`` stats block for free.
     """
 
     def __init__(
@@ -161,8 +175,12 @@ class Telemetry:
         journal_size: int = 256,
         journal_path: Optional[str] = None,
         max_spans: int = 512,
+        slo: Optional[SLOTracker] = None,
     ) -> None:
         self.metrics = registry if registry is not None else MetricsRegistry()
+        #: the SLO scoring shared with /stats and the fleet simulator —
+        #: end_trace feeds it one event per completed request
+        self.slo = slo if slo is not None else SLOTracker()
         self._max_spans = int(max_spans)
         #: guards _active/_ring/_completed; LEAF (never calls out — see module doc)
         self._lock = threading.Lock()
@@ -269,18 +287,38 @@ class Telemetry:
             "Pool blocks allocated per admitted request (paged engines)",
             log_buckets(1.0, 2.0, 12),
         )
+        # per-class SLO surface (ISSUE 15): attainment over the longest
+        # configured rolling window, and the error-budget burn rate per
+        # (class, window) — the same numbers the generation.slo stats block
+        # and the simulator's report read from the shared SLOTracker
+        self.slo_attainment = m.gauge(
+            "unionml_slo_attainment",
+            "Rolling-window SLO attainment fraction per class",
+            ("cls",),
+        )
+        self.slo_burn_rate = m.gauge(
+            "unionml_slo_burn_rate",
+            "Error-budget burn rate per class and rolling window",
+            ("cls", "window"),
+        )
 
     # ------------------------------------------------------------------ traces
 
     def new_trace(
-        self, request_id: Optional[str] = None, *, cls: str = "standard", **attrs: Any
+        self,
+        request_id: Optional[str] = None,
+        *,
+        cls: str = "standard",
+        session_id: Optional[str] = None,
+        **attrs: Any,
     ) -> str:
         """Open (or join) the trace for ``request_id``; returns the id.
 
         Idempotent on an already-active id — the fleet opens the trace
         before routing and the replica batcher joins it, so failover
         keeps one trace across engines. Re-opening refreshes nothing but
-        merges ``attrs``.
+        merges ``attrs`` (and sets ``session_id`` when newly provided —
+        the fleet knows it, the replica batcher does not).
         """
         rid = request_id if request_id else new_request_id()
         with self._lock:
@@ -293,6 +331,8 @@ class Telemetry:
                     cls=cls,
                 )
                 self._active[rid] = trace
+            if session_id is not None:
+                trace.session_id = session_id
             if attrs:
                 trace.attrs.update(attrs)
             if cls != "standard":
@@ -332,7 +372,12 @@ class Telemetry:
                 self._dropped_spans += 1
                 trace.attrs["spans_dropped"] = trace.attrs.get("spans_dropped", 0) + 1
                 return
-            trace.spans.append(Span(kind, (now - trace.t0) * 1e3, dur_ms, dict(attrs)))
+            span_attrs = dict(attrs)
+            if trace.session_id is not None and kind in ("admission", "queue_wait"):
+                # journal v2: the replay loader reads the session off these
+                # spans directly (emitters below the fleet never see it)
+                span_attrs.setdefault("session_id", trace.session_id)
+            trace.spans.append(Span(kind, (now - trace.t0) * 1e3, dur_ms, span_attrs))
 
     def note_tokens_in(self, request_id: Optional[str], n: int) -> None:
         self.tokens_in_total.inc(n)
@@ -425,6 +470,19 @@ class Telemetry:
         itl = trace.itl_ms
         if itl is not None:
             self.itl_ms.observe(itl, trace.cls)
+        # SLO scoring: TTFT compared at the journal's 3-decimal precision so
+        # live gauges and a simulator replay of this journal line can never
+        # disagree on a boundary case; gauges are set OUTSIDE both the
+        # tracker's and this object's lock (all three are leaves)
+        ttft = trace.ttft_ms
+        signal = self.slo.record(
+            trace.cls, status, None if ttft is None else round(ttft, 3)
+        )
+        if signal is not None:
+            if signal["attainment"] is not None:
+                self.slo_attainment.set(signal["attainment"], trace.cls)
+            for window, burn in signal["burn"].items():
+                self.slo_burn_rate.set(burn, trace.cls, window)
         if self.journal_path is not None:
             line = json.dumps(trace.to_dict(), separators=(",", ":"))
             try:
