@@ -1319,6 +1319,197 @@ def bench_speculative(iters: int = 20, max_new_tokens: int = 32, gamma: int = 4)
     }
 
 
+def bench_spec(modes=("on", "off"), max_new_tokens: int = 32, mesh_devices: int = 0,
+               train_steps: int = 120):
+    """Adaptive speculative decoding A/B on the paged int8 pool
+    (``bench_serving.py --spec {on,off,ab}``).
+
+    Both arms are the SAME :class:`SpeculativeEngine` configuration — identical
+    target+draft pools, so identical resident bytes by construction (the
+    equal-pool-byte contract; ``kv_pool_stats`` charges the draft leaves too).
+    The "off" arm admits every request with ``gamma=0``: zero proposals, one
+    emitted token per round — vanilla decode run through the very same round
+    program, which is what makes the identity gate BITWISE rather than
+    approximate.
+
+    Traffic is the SPECULATIVE_ANALYSIS.json recipe: a 4-layer char-GPT target
+    and 1-layer draft trained on the same corpus, measured on two splits —
+    in-distribution prompts (substrings of the training text, where the draft
+    agrees and γ ramps) and adversarial held-out prompts (an unseen pangram
+    plus uniform-random tokens, where acceptance collapses and γ must decay
+    to 0 rather than lose to the baseline).
+
+    The ``ab`` mode gates the tentpole's claim: in-distribution
+    accepted-tokens-per-target-step >= 1.4 AND held-out >= 0.95 (adaptive γ
+    never loses), with the on-arm streams token-identical to the off arm
+    (greedy AND fixed-seed sampled) and the greedy streams identical to a
+    PLAIN paged DecodeEngine at the same layout.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.models import GPTConfig, GPTLMHeadModel, create_train_state
+    from unionml_tpu.models.training import fit_lm
+    from unionml_tpu.serving.continuous import DecodeEngine
+    from unionml_tpu.serving.speculative import SpeculativeEngine
+
+    mesh = _serving_mesh(mesh_devices, 4) if mesh_devices else None
+    vocab = 128
+    text = (
+        "the quick brown fox jumps over the lazy dog. "
+        "pack my box with five dozen liquor jugs. "
+        "how vexingly quick daft zebras jump. "
+    ) * 80
+    heldout_sentence = "sphinx of black quartz, judge my vow. "
+    corpus = np.frombuffer(text.encode(), dtype=np.uint8).astype(np.int32) % vocab
+    rng = np.random.default_rng(0)
+    seqs = [
+        corpus[i : i + int(n)]
+        for i, n in zip(
+            rng.integers(0, len(corpus) - 64, size=400), rng.integers(16, 64, size=400)
+        )
+    ]
+
+    def train(num_layers: int):
+        cfg = GPTConfig.tiny(
+            vocab_size=vocab, hidden_size=64, num_layers=num_layers, num_heads=4,
+            max_position_embeddings=128, dropout=0.0, dtype=jnp.float32,
+            attention_impl="xla",
+        )
+        model = GPTLMHeadModel(cfg)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(num_layers)}, jnp.zeros((1, 64), jnp.int32),
+            deterministic=True,
+        )
+        state = create_train_state(model, variables, learning_rate=3e-3)
+        result = fit_lm(
+            state, seqs, seq_len=64, batch_size=32, num_steps=train_steps, pack=True,
+            log_every=10_000,
+        )
+        return model, {"params": result.state.params}
+
+    t0 = time.perf_counter()
+    target, t_vars = train(4)
+    draft, d_vars = train(1)
+    train_s = time.perf_counter() - t0
+
+    def encode(s):
+        return [c % vocab for c in s.encode()]
+
+    splits = {
+        "in_distribution": [
+            encode("the quick brown "), encode("pack my box "), encode("how vexingly "),
+            encode("jumps over the "),
+        ],
+        "held_out": [
+            encode(heldout_sentence[:16]), encode(heldout_sentence[7:23]),
+            rng.integers(1, vocab, size=12).tolist(),  # adversarial: pure noise
+            rng.integers(1, vocab, size=12).tolist(),
+        ],
+    }
+    MAX_LEN = 128
+
+    def make_engine(spec: bool):
+        cls = SpeculativeEngine if spec else DecodeEngine
+        kw = dict(
+            num_slots=4, max_len=MAX_LEN, prefill_buckets=(16,), mesh=mesh,
+            prefix_block_size=4, prefix_cache_blocks=64, kv_quantize="int8",
+            seed=11, temperature=0.0,
+        )
+        if spec:
+            return SpeculativeEngine(target, t_vars, draft, d_vars, **kw)
+        return DecodeEngine(target, t_vars, paged=True, **kw)
+
+    def drive(engine, reqs):
+        streams, slot_req = {}, {}
+        per_split = {}
+        for split, prompt, rid, sampling in reqs:
+            before = (
+                engine.spec_accepted, engine.spec_slot_rounds, engine.spec_fallback_rounds,
+            ) if isinstance(engine, SpeculativeEngine) else None
+            (slot,) = engine.admit_many([(prompt, max_new_tokens, sampling)])
+            slot_req[slot] = rid
+            streams[rid] = []
+            # one request at a time per split batch keeps the per-split
+            # acceptance attribution exact (counters are engine-lifetime)
+            while engine.num_active or engine.has_pending_prefill or engine.has_pending_events:
+                for ev in engine.step(1):
+                    if ev.emit:
+                        streams[slot_req[ev.slot]].append(ev.token)
+            if before is not None:
+                acc = engine.spec_accepted - before[0]
+                ran = (engine.spec_slot_rounds - before[1]) + (
+                    engine.spec_fallback_rounds - before[2]
+                )
+                agg = per_split.setdefault(split, {"accepted": 0, "rounds": 0})
+                agg["accepted"] += acc
+                agg["rounds"] += ran
+        return streams, per_split
+
+    def requests(sampling_extra):
+        reqs, rid = [], 0
+        for split, prompts in splits.items():
+            for prompt in prompts:
+                reqs.append((split, prompt, rid, dict(sampling_extra)))
+                rid += 1
+        return reqs
+
+    out = {
+        "max_new_tokens": max_new_tokens,
+        "mesh_devices": mesh_devices or 1,
+        "kv_quantize": "int8",
+        "train_wall_s": round(train_s, 1),
+        "splits": {k: len(v) for k, v in splits.items()},
+    }
+    arms = {}
+    for mode in modes:
+        extra = {"speculative": True} if mode == "on" else {"speculative": True, "gamma": 0}
+        engine = make_engine(spec=True)
+        t0 = time.perf_counter()
+        greedy, per_split = drive(engine, requests(extra))
+        wall = time.perf_counter() - t0
+        sampled, _ = drive(
+            make_engine(spec=True),
+            [(s, p, r, dict(x, temperature=0.8, seed=100 + r)) for s, p, r, x in requests(extra)],
+        )
+        entry = {
+            "wall_s": round(wall, 3),
+            "pool_bytes": engine.kv_pool_stats()["kv_pool_bytes"],
+            "draft_pool_bytes": engine.kv_pool_stats()["draft_kv_pool_bytes"],
+        }
+        for split, agg in per_split.items():
+            entry[f"accepted_per_target_step_{split}"] = (
+                round((agg["accepted"] + agg["rounds"]) / agg["rounds"], 4)
+                if agg["rounds"] else None
+            )
+        stats = engine.speculation_stats()
+        entry["rounds"] = stats["rounds"]
+        entry["fallback_rounds"] = stats["fallback_rounds"]
+        arms[mode] = {"entry": entry, "greedy": greedy, "sampled": sampled}
+        out[f"spec_{mode}"] = entry
+    if "on" in arms and "off" in arms:
+        # identity gates: on == off (greedy + fixed-seed sampled, bitwise —
+        # same round program both arms) and greedy == the PLAIN paged engine
+        plain, _ = drive(
+            make_engine(spec=False), [(s, p, r, {}) for s, p, r, x in requests({})]
+        )
+        out["token_identical_greedy"] = arms["on"]["greedy"] == arms["off"]["greedy"]
+        out["token_identical_sampled"] = arms["on"]["sampled"] == arms["off"]["sampled"]
+        out["token_identical_vs_plain"] = arms["on"]["greedy"] == plain
+        on = out["spec_on"]
+        out["aptps_in_distribution"] = on.get("accepted_per_target_step_in_distribution")
+        out["aptps_held_out"] = on.get("accepted_per_target_step_held_out")
+        out["gates"] = {
+            "in_distribution_min": 1.4,
+            "held_out_min": 0.95,
+            "in_distribution_pass": bool(
+                (out["aptps_in_distribution"] or 0) >= 1.4
+            ),
+            "held_out_pass": bool((out["aptps_held_out"] or 0) >= 0.95),
+        }
+    return out
+
+
 def bench_fleet(replica_counts=(1, 2, 4), n_groups=4, n_per_group=8,
                 prefix_tokens=24, suffix_tokens=6, max_new_tokens=16, num_slots=2):
     """Fleet scaling phase: a prefix-heavy request mix (``n_groups`` shared
@@ -1500,6 +1691,17 @@ def main():
                         "streams, else exits nonzero). Runs ONLY this phase "
                         "(like --pipeline); combine with --mesh N for the "
                         "head-sharded pool")
+    parser.add_argument("--spec", choices=("on", "off", "ab"), default=None,
+                        help="focused adaptive-speculative-decoding phase on the paged "
+                        "int8 pool: a trained char-GPT target+draft pair served through "
+                        "SpeculativeEngine, in-distribution + adversarial held-out "
+                        "prompt splits ('ab' runs spec-on vs the gamma=0 arm at "
+                        "identical pool bytes and GATES: accepted-tokens-per-target-"
+                        "step >= 1.4 in-distribution AND >= 0.95 held-out, with on-arm "
+                        "streams token-identical to the off arm — greedy and "
+                        "fixed-seed sampled — and to the plain paged engine, else "
+                        "exits nonzero). Runs ONLY this phase (like --paged); combine "
+                        "with --mesh N for the head-sharded pools")
     parser.add_argument("--int8", choices=("on", "off", "ab"), default=None,
                         help="focused int8-KV-pool phase: peak concurrent requests "
                         "+ decode tok/s at EQUAL pool byte budget (int8 blocks + "
@@ -1525,7 +1727,7 @@ def main():
 
     backend = jax.default_backend()
     if (args.pipeline or args.mesh or args.slo_mix or args.chaos or args.fleet
-            or args.obs or args.paged or args.int8):
+            or args.obs or args.paged or args.int8 or args.spec):
         import os
 
         base, ext = os.path.splitext(args.out)
@@ -1535,6 +1737,8 @@ def main():
             base = f"{base}_paged"
         if args.int8:
             base = f"{base}_int8"
+        if args.spec:
+            base = f"{base}_spec"
         if args.obs:
             base = f"{base}_obs"
         if args.slo_mix:
@@ -1732,6 +1936,44 @@ def main():
         # pinned logprob-delta/divergence quality budgets
         if len(modes) == 2 and not (
             ab["concurrency_ratio"] >= 1.8 and ab["quality"]["quality_ok"]
+        ):
+            return 1
+        return 0
+
+    if args.spec:
+        if args.mesh and len(jax.devices()) < args.mesh:
+            print(json.dumps({"metric": "spec_accepted_per_target_step",
+                              "error": f"--mesh {args.mesh} needs {args.mesh} devices, "
+                              f"found {len(jax.devices())}", "backend": backend}))
+            return 1
+        modes = ("on", "off") if args.spec == "ab" else (args.spec,)
+        ab = bench_spec(modes=modes, mesh_devices=args.mesh)
+        results["models"]["spec_ab" if len(modes) == 2 else f"spec_{modes[0]}"] = ab
+        line = {"metric": "spec_accepted_per_target_step", "backend": backend,
+                "mesh_devices": args.mesh or 1}
+        for mode in modes:
+            line[f"rounds_{mode}"] = ab[f"spec_{mode}"]["rounds"]
+            line[f"wall_s_{mode}"] = ab[f"spec_{mode}"]["wall_s"]
+        if len(modes) == 2:
+            line["aptps_in_distribution"] = ab["aptps_in_distribution"]
+            line["aptps_held_out"] = ab["aptps_held_out"]
+            line["token_identical_greedy"] = ab["token_identical_greedy"]
+            line["token_identical_sampled"] = ab["token_identical_sampled"]
+            line["token_identical_vs_plain"] = ab["token_identical_vs_plain"]
+            line["gates_pass"] = bool(
+                ab["gates"]["in_distribution_pass"] and ab["gates"]["held_out_pass"]
+            )
+        print(json.dumps(line))
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"[bench_serving] wrote {args.out}", file=sys.stderr)
+        # the A/B GATES the tentpole's claim IN ONE RUN: adaptive gamma must
+        # beat vanilla >= 1.4x where the draft helps AND stay >= 0.95x on
+        # adversarial traffic, WITHOUT changing a single emitted token
+        if len(modes) == 2 and not (
+            ab["token_identical_greedy"] and ab["token_identical_sampled"]
+            and ab["token_identical_vs_plain"]
+            and ab["gates"]["in_distribution_pass"] and ab["gates"]["held_out_pass"]
         ):
             return 1
         return 0

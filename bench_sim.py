@@ -71,6 +71,12 @@ def main():
     parser.add_argument("--journal", default=None, metavar="PATH",
                         help="fit the virtual-clock cost model from this serving "
                              "journal (JSONL) instead of the defaults")
+    parser.add_argument("--spec-alpha", type=float, default=0.0, metavar="ALPHA",
+                        help="speculative-decoding acceptance rate for the cost "
+                             "model's ITL term (0 disables; e.g. 0.86 is the "
+                             "measured in-distribution char-GPT value from "
+                             "SPECULATIVE_ANALYSIS.json). Applies to the "
+                             "workload's speculative classes (interactive)")
     parser.add_argument("--out", default="SIM_BENCH.json",
                         help="artifact path; always diverted to the _cpu sibling — "
                              "the sim is host arithmetic, the CPU run is canonical")
@@ -89,7 +95,7 @@ def main():
 
     args.out = resolve_artifact_path(args.out, "cpu")
 
-    cost = CostModel()
+    cost = CostModel(spec_alpha=args.spec_alpha)
     if args.journal:
         cost = fit_cost_model(load_journal(args.journal), default=cost)
 
@@ -140,6 +146,9 @@ def main():
             "prefill_ms_per_token": cost.prefill_ms_per_token,
             "itl_ms": cost.itl_ms,
             "dispatch_ms": cost.dispatch_ms,
+            "spec_alpha": cost.spec_alpha,
+            "spec_gamma": cost.spec_gamma,
+            "spec_itl_scale_interactive": round(cost.spec_itl_scale("interactive"), 4),
         },
         "arms": arms,
         "gate": {

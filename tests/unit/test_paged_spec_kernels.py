@@ -1,0 +1,213 @@
+"""Speculative verify/commit kernels on the paged pool: bitwise parity.
+
+The SpeculativeEngine's exactness guarantee reduces to two model-level facts
+pinned here, on fp32 AND int8 pools, uniform and ragged per-row positions:
+
+1. VERIFY — feeding an S-token chunk at per-row positions through the paged
+   path (``_paged_verify_chunk`` behind ``DecoderBlock``) matches feeding the
+   same tokens one at a time through per-row decode, because each scan step
+   mirrors the append arithmetic (including int8 block-scale growth +
+   old-code requantization) into a local gathered copy and attends with
+   vanilla shapes — while the POOL LEAVES COME BACK UNTOUCHED (a rejected
+   proposal must never perturb pool bytes or scales).
+2. COMMIT — ``paged_commit_chunk`` of the first ``m`` chunk tokens leaves the
+   pool equal to ``m`` sequential decode appends; rows with ``counts == 0``
+   route through the scratch column and their data blocks keep their exact
+   prior bytes.
+
+Equality grades: the fp32 pool is BITWISE across logits and pool bytes. On
+the int8 pool the quantized CODES are bitwise too, but the f32 scale leaves
+may sit 1 ULP apart: XLA fuses the dense projections differently in the
+seq=1 vs seq=S programs of the quantized family, and while ``round()``
+absorbs the last-bit difference in every code, the raw ``max|v|/127`` scale
+keeps it. That residual is why spec-vs-PLAIN-engine int8 comparisons ride
+the existing divergence budget (test_paged_kv) while spec-on vs spec-off —
+both arms running the SAME round program — stays bitwise by construction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.models.gpt import (
+    block_table_width,
+    init_block_pool,
+    init_block_tables,
+    paged_commit_chunk,
+)
+
+BS = 4
+MAX_LEN = 32
+NSLOTS = 3
+
+
+@pytest.fixture(scope="module")
+def gpt(gpt_tiny_session):
+    _, model, variables = gpt_tiny_session
+    return model, variables
+
+
+def _fresh_state(model, kv_quantize):
+    cfg = model.config
+    width = block_table_width(MAX_LEN, BS)
+    per_slot = width - 1
+    num_blocks = NSLOTS * per_slot + 1  # + scratch
+    pool = init_block_pool(cfg, num_blocks, BS, kv_quantize=kv_quantize)
+    scratch = num_blocks - 1
+    tables = np.full((NSLOTS, width), scratch, dtype=np.int32)
+    for row in range(NSLOTS):
+        tables[row, :per_slot] = np.arange(
+            row * per_slot, (row + 1) * per_slot, dtype=np.int32
+        )
+    return pool, jnp.asarray(tables)
+
+
+def _apply(model, variables, pool, tables, tokens, positions):
+    """One paged forward at per-row positions; returns (logits, new pool or
+    verify cache). ``tokens``: (n, S) np.int32; ``positions``: (n,) np.int32."""
+    cache = {"table": tables, **pool}
+    logits, new_cache = model.apply(
+        variables,
+        jnp.asarray(tokens, dtype=jnp.int32),
+        cache=cache,
+        position=jnp.asarray(positions, dtype=jnp.int32),
+    )
+    new_cache = dict(new_cache)
+    new_cache.pop("table", None)
+    return np.asarray(logits), new_cache
+
+
+def _assert_leaf_close(got, want, name, context):
+    got, want = np.asarray(got), np.asarray(want)
+    if name.endswith("_scale"):
+        # int8 scale leaves: few-ULP slack for program-shape fusion (see
+        # module docstring); everything else — codes included — is bitwise
+        np.testing.assert_allclose(
+            got, want, rtol=1e-6, atol=0, err_msg=f"{context}: {name}"
+        )
+    else:
+        assert np.array_equal(got, want), f"{context}: {name}"
+
+
+def _assert_pools_close(a, b, context):
+    for layer in a:
+        for name in b[layer]:
+            _assert_leaf_close(a[layer][name], b[layer][name], name, f"{context} {layer}")
+
+
+@pytest.mark.parametrize("kv", [None, "int8"], ids=["fp32-pool", "int8-pool"])
+@pytest.mark.parametrize("ragged", [False, True], ids=["uniform", "ragged"])
+def test_verify_chunk_matches_sequential_decode_bitwise(gpt, kv, ragged):
+    model, variables = gpt
+    pool, tables = _fresh_state(model, kv)
+    rng = np.random.default_rng(0)
+    lens = np.array([6, 3, 5], dtype=np.int32) if ragged else np.array([5, 5, 5], dtype=np.int32)
+    S = 4
+    # build each row's prefix through per-row single-token decode (append path)
+    for j in range(int(lens.max())):
+        toks = rng.integers(1, model.config.vocab_size, size=(NSLOTS, 1)).astype(np.int32)
+        pos = np.minimum(j, lens - 1).astype(np.int32)  # short rows re-write their tail: harmless, deterministic
+        _, pool = _apply(model, variables, pool, tables, toks, pos)
+    chunk = rng.integers(1, model.config.vocab_size, size=(NSLOTS, S)).astype(np.int32)
+
+    # branch A: sequential per-row decode, one token at a time
+    seq_pool = pool
+    seq_logits = []
+    for j in range(S):
+        lg, seq_pool = _apply(model, variables, seq_pool, tables, chunk[:, j : j + 1], lens + j)
+        seq_logits.append(lg[:, 0, :])
+    seq_logits = np.stack(seq_logits, axis=1)  # (n, S, vocab)
+
+    # branch B: one verify chunk at the same positions
+    ver_logits, ver_cache = _apply(model, variables, pool, tables, chunk, lens)
+
+    if kv is None:
+        assert np.array_equal(ver_logits, seq_logits), "verify logits diverge from sequential decode"
+    else:
+        # scale 1-ULP slack (module docstring) reaches logits at ~1e-6
+        np.testing.assert_allclose(ver_logits, seq_logits, atol=2e-5, rtol=1e-5)
+    # the pool leaves came back untouched (same bytes; ck/cv ride alongside)
+    for layer, leaves in pool.items():
+        for name in leaves:
+            assert np.array_equal(
+                np.asarray(ver_cache[layer][name]), np.asarray(leaves[name])
+            ), f"verify wrote the pool: {layer}/{name}"
+        assert "ck" in ver_cache[layer] and "cv" in ver_cache[layer]
+
+    # commit ALL S tokens: pool must equal the sequential trajectory bitwise
+    committed = {
+        layer: paged_commit_chunk(
+            pool[layer],
+            tables,
+            jnp.asarray(lens),
+            jnp.full((NSLOTS,), S, dtype=jnp.int32),
+            ver_cache[layer]["ck"],
+            ver_cache[layer]["cv"],
+        )
+        for layer in pool
+    }
+    _assert_pools_close(committed, seq_pool, "commit vs sequential appends")
+
+
+@pytest.mark.parametrize("kv", [None, "int8"], ids=["fp32-pool", "int8-pool"])
+def test_partial_commit_matches_prefix_and_zero_count_rows_untouched(gpt, kv):
+    """counts[row] < S commits exactly the accepted prefix; counts == 0 rows
+    (inactive / fully rejected) keep their data blocks bit-identical."""
+    model, variables = gpt
+    pool, tables = _fresh_state(model, kv)
+    rng = np.random.default_rng(1)
+    lens = np.array([4, 6, 5], dtype=np.int32)
+    S = 4
+    counts = np.array([2, 0, 4], dtype=np.int32)
+    for j in range(int(lens.max())):
+        toks = rng.integers(1, model.config.vocab_size, size=(NSLOTS, 1)).astype(np.int32)
+        _, pool = _apply(model, variables, pool, tables, toks, np.minimum(j, lens - 1))
+    chunk = rng.integers(1, model.config.vocab_size, size=(NSLOTS, S)).astype(np.int32)
+
+    # reference: feed row r's first counts[r] chunk tokens sequentially, with
+    # dead rows parked on their own tail position (the engine masks them out;
+    # here we simply skip them via per-row position freezing into scratch)
+    _, ver_cache = _apply(model, variables, pool, tables, chunk, lens)
+    committed = {
+        layer: paged_commit_chunk(
+            pool[layer],
+            tables,
+            jnp.asarray(lens),
+            jnp.asarray(counts),
+            ver_cache[layer]["ck"],
+            ver_cache[layer]["cv"],
+        )
+        for layer in pool
+    }
+
+    # sequential reference built row-by-row on a single-row table view
+    ref_pool = pool
+    for j in range(S):
+        live = j < counts
+        if not live.any():
+            break
+        # feed only live rows: dead rows target the scratch column like commit
+        width = block_table_width(MAX_LEN, BS)
+        sentinel = (width - 1) * BS
+        pos = np.where(live, lens + j, sentinel).astype(np.int32)
+        lg, ref_pool = _apply(model, variables, ref_pool, tables, chunk[:, j : j + 1], pos)
+
+    # compare only DATA blocks (scratch absorbs garbage in both trajectories)
+    data_blocks = np.asarray(tables)[:, :-1].reshape(-1)
+    for layer in pool:
+        for name in pool[layer]:
+            _assert_leaf_close(
+                np.asarray(committed[layer][name])[data_blocks],
+                np.asarray(ref_pool[layer][name])[data_blocks],
+                name,
+                f"partial commit {layer}",
+            )
+    # zero-count row's data blocks are bit-identical to the pre-commit pool
+    row1 = np.asarray(tables)[1, :-1]
+    for layer in pool:
+        for name in pool[layer]:
+            assert np.array_equal(
+                np.asarray(committed[layer][name])[row1],
+                np.asarray(pool[layer][name])[row1],
+            ), f"zero-count row perturbed: {layer}/{name}"

@@ -115,6 +115,12 @@ run serving_paged 300 python bench_serving.py --paged ab
 # paged pool AND the pinned logprob-delta/divergence quality budgets, gated
 # in the same run (exits nonzero on either failure)
 run serving_int8 300 python bench_serving.py --int8 ab
+# adaptive speculative decoding A/B on the paged int8 pool: spec-on vs the
+# gamma=0 arm at identical pool bytes — accepted-tokens-per-target-step
+# >= 1.4 in-distribution AND >= 0.95 on adversarial held-out traffic, with
+# every stream token-identical (greedy + fixed-seed sampled, and vs the
+# plain paged engine); exits nonzero on any gate or identity failure
+run serving_spec 600 python bench_serving.py --spec ab
 # telemetry overhead A/B: span tracing + metrics on vs off over the same
 # concurrent mix — best-of-3 decode tok/s per arm (the phase exits nonzero
 # when the enabled arm regresses more than 2%, holding the zero-overhead

@@ -164,7 +164,14 @@ SPECS: Tuple[ResourceSpec, ...] = (
         # the '# owns:' release point) or by adoption into the radix index.
         # kv_quantize="int8" adds no paths here: the scale arrays are pool
         # device leaves indexed by the SAME block ids this grant tracks, so
-        # the existing acquire/release sites cover their lifetime too.
+        # the existing acquire/release sites cover their lifetime too. The
+        # speculative draft pool (SpeculativeEngine._draft_pool) is the same
+        # story one level up: draft K/V leaves are a SECOND set of pool
+        # arrays indexed by the one shared block table — there is no draft
+        # allocator and no draft grant, so freeing the target grant IS
+        # freeing the draft blocks, and any new draft-side alloc/free
+        # entry point must route through _alloc_slot_blocks /
+        # _free_slot_blocks to stay inside this spec.
         "kv-block",
         "slot-owned KV block grant",
         acquires=(

@@ -148,6 +148,144 @@ def _paged_chunk_quantized(pool_q, pool_scale, table_row, position, vals):
     return pool_q.at[dst].set(new_q), pool_scale.at[dst].set(new_scale)
 
 
+def _paged_verify_chunk(cache, block_table, position, q, k, v, out_dtype):
+    """(jit-traceable) Speculative verify: attention context for ``S`` chunk
+    tokens per row over the row's paged prefix, WITHOUT writing the pool.
+
+    ``q``/``k``/``v`` are ``(batch, heads, S, head_dim)`` fresh projections for
+    chunk tokens at per-row positions ``[position, position + S)``. The pool
+    leaves in ``cache`` stay untouched — a rejected proposal must never perturb
+    the pool, and in the int8 layout even an overwritten junk token would
+    permanently inflate a block's monotone absmax scale. Numerics are
+    BIT-IDENTICAL to feeding the chunk one token at a time through the decode
+    append: each scan step mirrors the append arithmetic
+    (:func:`_paged_append_quantized` / the fp ``.at[].set``) into a LOCAL
+    gathered copy of the row's blocks, dequantizes, and runs the same
+    ``(1, capacity)`` masked attention shape vanilla decode runs — so accepted
+    tokens score exactly as they would have under plain decoding, and the
+    engine's commit (:func:`paged_commit_chunk`) replays the same appends into
+    the real pool. The attention rows serialize over ``S`` (tiny, bandwidth-
+    equal to S vanilla steps); the win stays in the dense projections/MLP,
+    which batch all S tokens per dispatch.
+    """
+    batch, heads, S, head_dim = q.shape
+    block_size = cache["k"].shape[2]
+    width = block_table.shape[1]
+    capacity = width * block_size
+    quantized = "k_scale" in cache
+    b_idx = jnp.arange(batch)
+    k_pos = jnp.arange(capacity)
+    pos0 = position.astype(jnp.int32)
+
+    def local(leaf):
+        # (batch, heads, width, bs, hd): the row's blocks, block structure kept
+        return jnp.moveaxis(leaf[block_table], 2, 1)
+
+    if quantized:
+        state = (
+            local(cache["k"]).astype(jnp.float32), local(cache["k_scale"]),
+            local(cache["v"]).astype(jnp.float32), local(cache["v_scale"]),
+        )
+    else:
+        state = (local(cache["k"]), local(cache["v"]))
+
+    def append_q(codes, scales, blk, off, vals):
+        # _paged_append_quantized on the gathered layout, arithmetic bit for bit
+        # (codes live as exact integers in f32, so round/clip/rescale match)
+        old_q = codes[b_idx, :, blk]
+        old_scale = scales[b_idx, :, blk]
+        vals32 = vals.astype(jnp.float32)[:, :, None, :]
+        tok_scale = jnp.max(jnp.abs(vals32), axis=-1, keepdims=True) / 127.0
+        fresh = (off == 0)[:, None, None, None]
+        eff_old = jnp.where(fresh, 0.0, old_scale)
+        new_scale = jnp.maximum(eff_old, tok_scale)
+        safe = jnp.where(new_scale > 0, new_scale, 1.0)
+        rescaled = jnp.round(old_q * (eff_old / safe))
+        tok_q = jnp.round(vals32 / safe)
+        slot_idx = jnp.arange(block_size)[None, None, :, None]
+        off_b = off[:, None, None, None]
+        new_q = jnp.where(slot_idx < off_b, rescaled, jnp.where(slot_idx == off_b, tok_q, 0.0))
+        new_q = jnp.clip(new_q, -127, 127)
+        return codes.at[b_idx, :, blk].set(new_q), scales.at[b_idx, :, blk].set(new_scale)
+
+    def step(state, j):
+        pos = jnp.clip(pos0 + j, 0, capacity - 1)
+        blk, off = pos // block_size, pos % block_size
+        kj = jax.lax.dynamic_index_in_dim(k, j, axis=2, keepdims=False)
+        vj = jax.lax.dynamic_index_in_dim(v, j, axis=2, keepdims=False)
+        if quantized:
+            kc, ks, vc, vs = state
+            kc, ks = append_q(kc, ks, blk, off, kj)
+            vc, vs = append_q(vc, vs, blk, off, vj)
+            state = (kc, ks, vc, vs)
+            k_full = (kc * ks).reshape(batch, heads, capacity, head_dim).astype(out_dtype)
+            v_full = (vc * vs).reshape(batch, heads, capacity, head_dim).astype(out_dtype)
+        else:
+            kb, vb = state
+            kb = kb.at[b_idx, :, blk, off].set(kj.astype(kb.dtype))
+            vb = vb.at[b_idx, :, blk, off].set(vj.astype(vb.dtype))
+            state = (kb, vb)
+            k_full = kb.reshape(batch, heads, capacity, head_dim)
+            v_full = vb.reshape(batch, heads, capacity, head_dim)
+        qj = jax.lax.dynamic_index_in_dim(q, j, axis=2)  # (batch, heads, 1, hd)
+        mask = (k_pos[None, None, :] <= pos[:, None, None])[:, None, :, :]
+        ctx = xla_attention(qj, k_full, v_full, mask=mask)
+        return state, ctx[:, :, 0, :]
+
+    _, rows = jax.lax.scan(step, state, jnp.arange(S, dtype=jnp.int32))
+    return jnp.moveaxis(rows, 0, 2)  # (batch, heads, S, head_dim)
+
+
+def paged_commit_chunk(layer_cache, block_table, position, counts, ck, cv):
+    """(jit-traceable) Commit the first ``counts[row]`` verified chunk tokens
+    of one layer into the paged pool as SEQUENTIAL single-token appends.
+
+    ``ck``/``cv`` are the ``(batch, heads, S, head_dim)`` fresh K/V a verify
+    pass stashed (see :func:`_paged_verify_chunk`); row positions start at
+    ``position`` (the row's pre-round length). Chunk indices ``j >=
+    counts[row]`` — rejected proposals and everything past a retirement — and
+    fully inactive rows (``counts == 0``) route through the trailing scratch
+    column, so the pool never learns a rejected token existed and the int8
+    block-scale trajectory is exactly the one plain decoding would have
+    produced for the accepted prefix.
+    """
+    quantized = "k_scale" in layer_cache
+    block_size = layer_cache["k"].shape[2]
+    width = block_table.shape[1]
+    capacity = width * block_size
+    sentinel = (width - 1) * block_size
+    S = ck.shape[2]
+    pos0 = position.astype(jnp.int32)
+
+    def step(carry, j):
+        live = j < counts
+        pos = jnp.clip(jnp.where(live, pos0 + j, sentinel), 0, capacity - 1)
+        blk, off = pos // block_size, pos % block_size
+        dst = jnp.take_along_axis(block_table, blk[:, None], axis=1)[:, 0]
+        kj = jax.lax.dynamic_index_in_dim(ck, j, axis=2, keepdims=False)
+        vj = jax.lax.dynamic_index_in_dim(cv, j, axis=2, keepdims=False)
+        if quantized:
+            kq, ks, vq, vs = carry
+            kq, ks = _paged_append_quantized(kq, ks, dst, off, kj)
+            vq, vs = _paged_append_quantized(vq, vs, dst, off, vj)
+            return (kq, ks, vq, vs), None
+        kb, vb = carry
+        kb = kb.at[dst, :, off, :].set(kj.astype(kb.dtype))
+        vb = vb.at[dst, :, off, :].set(vj.astype(vb.dtype))
+        return (kb, vb), None
+
+    if quantized:
+        carry = (
+            layer_cache["k"], layer_cache["k_scale"],
+            layer_cache["v"], layer_cache["v_scale"],
+        )
+        (kq, ks, vq, vs), _ = jax.lax.scan(step, carry, jnp.arange(S, dtype=jnp.int32))
+        return {"k": kq, "k_scale": ks, "v": vq, "v_scale": vs}
+    carry = (layer_cache["k"], layer_cache["v"])
+    (kb, vb), _ = jax.lax.scan(step, carry, jnp.arange(S, dtype=jnp.int32))
+    return {"k": kb, "v": vb}
+
+
 class DecoderBlock(nn.Module):
     config: GPTConfig
     use_moe: bool = False
@@ -232,85 +370,93 @@ class DecoderBlock(nn.Module):
             new_cache = None
         elif block_table is not None:
             per_row = not isinstance(position, int) and jnp.ndim(position) == 1
-            if per_row and seq != 1:
-                raise ValueError("per-row cache positions require single-token decode (seq=1)")
             if pad_offsets is not None:
                 raise ValueError("paged decode does not support pad_offsets (left-padded rows)")
-            block_size = cache["k"].shape[2]
-            width = block_table.shape[1]
-            capacity = width * block_size
-            # an int8-quantized pool announces itself structurally: scale leaves
-            # ride next to k/v (see init_block_pool), so skip-listed layers fall
-            # through to the full-precision path with zero config plumbing
-            quantized = "k_scale" in cache
-            k_scale = v_scale = None
-            if per_row:
-                # decode: each row appends one token into its own tail block
-                pos = jnp.clip(position.astype(jnp.int32), 0, capacity - 1)
-                blk, off = pos // block_size, pos % block_size
-                dst = jnp.take_along_axis(block_table, blk[:, None], axis=1)[:, 0]
-                if quantized:
-                    k_cache, k_scale = _paged_append_quantized(
-                        cache["k"], cache["k_scale"], dst, off, k[:, :, 0, :]
-                    )
-                    v_cache, v_scale = _paged_append_quantized(
-                        cache["v"], cache["v_scale"], dst, off, v[:, :, 0, :]
-                    )
-                else:
-                    k_cache = cache["k"].at[dst, :, off, :].set(k[:, :, 0, :].astype(cache["k"].dtype))
-                    v_cache = cache["v"].at[dst, :, off, :].set(v[:, :, 0, :].astype(cache["v"].dtype))
-            else:
-                # chunked prefill through the table (batch=1): scatter the chunk's
-                # K/V at positions [position, position+seq) of row 0's blocks
-                if batch != 1:
-                    raise ValueError("paged chunk prefill requires batch == 1")
-                if quantized:
-                    k_cache, k_scale = _paged_chunk_quantized(
-                        cache["k"], cache["k_scale"], block_table[0], position, k[0]
-                    )
-                    v_cache, v_scale = _paged_chunk_quantized(
-                        cache["v"], cache["v_scale"], block_table[0], position, v[0]
-                    )
-                else:
-                    pos = jnp.clip((position + jnp.arange(seq)).astype(jnp.int32), 0, capacity - 1)
-                    blk, off = pos // block_size, pos % block_size
-                    dst = jnp.take(block_table[0], blk)
-                    k_cache = cache["k"].at[dst, :, off, :].set(
-                        jnp.moveaxis(k[0], 1, 0).astype(cache["k"].dtype)
-                    )
-                    v_cache = cache["v"].at[dst, :, off, :].set(
-                        jnp.moveaxis(v[0], 1, 0).astype(cache["v"].dtype)
-                    )
-
-            def gather_table(pool_leaf, scale_leaf=None):
-                # (batch, width, heads, bs, hd) -> (batch, heads, width*bs, hd):
-                # logical position p lands at flattened column blk*bs+off == p,
-                # so downstream masking is position arithmetic, same as dense
-                blocks = pool_leaf[block_table]
-                if scale_leaf is not None:
-                    # dequantize inside the gather: int8 is what crossed HBM, the
-                    # per-block-per-head scale rides the same table gather (shard-
-                    # local under the head-sharded pool spec), and empty blocks
-                    # (scale 0) decode to exact zeros the mask already discards
-                    blocks = (blocks.astype(jnp.float32) * scale_leaf[block_table]).astype(cfg.dtype)
-                return jnp.moveaxis(blocks, 2, 1).reshape(
-                    batch, cfg.num_heads, capacity, cfg.head_dim
+            if per_row and seq != 1:
+                # speculative verify: score S chunk tokens per row against the
+                # row's paged prefix without writing the pool; the engine commits
+                # accepted tokens afterwards (paged_commit_chunk) from the fresh
+                # K/V stashed alongside the untouched pool leaves
+                context = _paged_verify_chunk(
+                    cache, block_table, position, q, k, v, cfg.dtype
                 )
-
-            k_pos = jnp.arange(capacity)
-            if per_row:
-                q_pos = position[:, None] + jnp.arange(seq)[None, :]  # (batch, seq)
-                mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, :, :]
+                new_cache = {**cache, "ck": k, "cv": v}
             else:
-                q_pos = position + jnp.arange(seq)
-                mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
-            context = xla_attention(
-                q, gather_table(k_cache, k_scale), gather_table(v_cache, v_scale), mask=mask
-            )
-            new_cache = {"k": k_cache, "v": v_cache}
-            if quantized:
-                new_cache["k_scale"] = k_scale
-                new_cache["v_scale"] = v_scale
+                block_size = cache["k"].shape[2]
+                width = block_table.shape[1]
+                capacity = width * block_size
+                # an int8-quantized pool announces itself structurally: scale leaves
+                # ride next to k/v (see init_block_pool), so skip-listed layers fall
+                # through to the full-precision path with zero config plumbing
+                quantized = "k_scale" in cache
+                k_scale = v_scale = None
+                if per_row:
+                    # decode: each row appends one token into its own tail block
+                    pos = jnp.clip(position.astype(jnp.int32), 0, capacity - 1)
+                    blk, off = pos // block_size, pos % block_size
+                    dst = jnp.take_along_axis(block_table, blk[:, None], axis=1)[:, 0]
+                    if quantized:
+                        k_cache, k_scale = _paged_append_quantized(
+                            cache["k"], cache["k_scale"], dst, off, k[:, :, 0, :]
+                        )
+                        v_cache, v_scale = _paged_append_quantized(
+                            cache["v"], cache["v_scale"], dst, off, v[:, :, 0, :]
+                        )
+                    else:
+                        k_cache = cache["k"].at[dst, :, off, :].set(k[:, :, 0, :].astype(cache["k"].dtype))
+                        v_cache = cache["v"].at[dst, :, off, :].set(v[:, :, 0, :].astype(cache["v"].dtype))
+                else:
+                    # chunked prefill through the table (batch=1): scatter the chunk's
+                    # K/V at positions [position, position+seq) of row 0's blocks
+                    if batch != 1:
+                        raise ValueError("paged chunk prefill requires batch == 1")
+                    if quantized:
+                        k_cache, k_scale = _paged_chunk_quantized(
+                            cache["k"], cache["k_scale"], block_table[0], position, k[0]
+                        )
+                        v_cache, v_scale = _paged_chunk_quantized(
+                            cache["v"], cache["v_scale"], block_table[0], position, v[0]
+                        )
+                    else:
+                        pos = jnp.clip((position + jnp.arange(seq)).astype(jnp.int32), 0, capacity - 1)
+                        blk, off = pos // block_size, pos % block_size
+                        dst = jnp.take(block_table[0], blk)
+                        k_cache = cache["k"].at[dst, :, off, :].set(
+                            jnp.moveaxis(k[0], 1, 0).astype(cache["k"].dtype)
+                        )
+                        v_cache = cache["v"].at[dst, :, off, :].set(
+                            jnp.moveaxis(v[0], 1, 0).astype(cache["v"].dtype)
+                        )
+
+                def gather_table(pool_leaf, scale_leaf=None):
+                    # (batch, width, heads, bs, hd) -> (batch, heads, width*bs, hd):
+                    # logical position p lands at flattened column blk*bs+off == p,
+                    # so downstream masking is position arithmetic, same as dense
+                    blocks = pool_leaf[block_table]
+                    if scale_leaf is not None:
+                        # dequantize inside the gather: int8 is what crossed HBM, the
+                        # per-block-per-head scale rides the same table gather (shard-
+                        # local under the head-sharded pool spec), and empty blocks
+                        # (scale 0) decode to exact zeros the mask already discards
+                        blocks = (blocks.astype(jnp.float32) * scale_leaf[block_table]).astype(cfg.dtype)
+                    return jnp.moveaxis(blocks, 2, 1).reshape(
+                        batch, cfg.num_heads, capacity, cfg.head_dim
+                    )
+
+                k_pos = jnp.arange(capacity)
+                if per_row:
+                    q_pos = position[:, None] + jnp.arange(seq)[None, :]  # (batch, seq)
+                    mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, :, :]
+                else:
+                    q_pos = position + jnp.arange(seq)
+                    mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+                context = xla_attention(
+                    q, gather_table(k_cache, k_scale), gather_table(v_cache, v_scale), mask=mask
+                )
+                new_cache = {"k": k_cache, "v": v_cache}
+                if quantized:
+                    new_cache["k_scale"] = k_scale
+                    new_cache["v_scale"] = v_scale
         else:
             per_row = not isinstance(position, int) and jnp.ndim(position) == 1
             if per_row and seq != 1:

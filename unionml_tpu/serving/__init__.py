@@ -10,7 +10,7 @@ from unionml_tpu.serving.metrics import MetricsRegistry
 from unionml_tpu.serving.prefix_cache import PrefixCache
 from unionml_tpu.serving.scheduler import SchedulerConfig, SLOScheduler
 from unionml_tpu.serving.slo import SLOConfig, SLOObjective, SLOTracker
-from unionml_tpu.serving.speculative import SpeculativeBatcher
+from unionml_tpu.serving.speculative import SpeculativeBatcher, SpeculativeEngine
 from unionml_tpu.serving.supervisor import EngineSupervisor
 from unionml_tpu.serving.telemetry import Telemetry
 from unionml_tpu.serving.resident import ResidentPredictor
@@ -81,6 +81,8 @@ __all__ = [
     "SLOScheduler",
     "SLOTracker",
     "SchedulerConfig",
+    "SpeculativeBatcher",
+    "SpeculativeEngine",
     "Telemetry",
     "split_mesh",
     "build_aiohttp_app",

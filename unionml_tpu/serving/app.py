@@ -739,6 +739,12 @@ def build_aiohttp_app(
                 "requests_admitted": getattr(gen.engine, "requests_admitted", 0),
                 "tokens_decoded": getattr(gen.engine, "tokens_decoded", 0),
             }
+            spec_stats = getattr(gen.engine, "speculation_stats", None)
+            if callable(spec_stats):
+                # speculative decoding observability: acceptance EMA, current
+                # adaptive γ, round/fallback counters, and the accepted-tokens-
+                # per-target-step ratio the bench gates on
+                payload["generation"]["speculation"] = spec_stats()
             pipeline_stats = getattr(gen.engine, "pipeline_stats", None)
             if callable(pipeline_stats):
                 # pipelined-decode observability: depth, host-gap EMA (ms the

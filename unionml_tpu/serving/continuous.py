@@ -2372,6 +2372,69 @@ class DecodeEngine:
         )
         return StepEvent(slot=slot, token=-1, emit=False, finished=True, error="nan_logits")
 
+    def _dispatch_step(self, lookahead: int) -> Tuple[Any, Any, Any, int]:
+        """Dispatch ONE compiled decode burst; return ``(tokens, masks, bads,
+        n_steps)`` of the in-flight result (device arrays, not yet fetched).
+
+        The seam :meth:`step` drives and subclasses override: the speculative
+        engine swaps in its round program here (returning ``n_steps`` = the
+        round's burst rows) while every surrounding concern — fault paths,
+        pipelining, accounting, replay — stays in :meth:`step` unchanged.
+        Exceptions propagate to the caller's ``_on_failure`` path.
+        """
+        # the all-greedy program skips the sampling machinery; heterogeneous slots
+        # share the sampling program with per-row controls. Everything the step
+        # consumes — activity, budgets, sampling controls — rides as
+        # device-resident mirrors (refreshed in _activate/cancel/reset), so a
+        # steady-state tick performs ZERO host→device transfers (pinned by the
+        # transfer-guard regression test).
+        sampling = bool((self._slot_temp[self._active] > 0).any())
+        fn = self._step_fns.get((lookahead, sampling))
+        if fn is None:
+            fn = self._step_fns[(lookahead, sampling)] = self._make_step(lookahead, sampling)
+        if self._faults is not None:
+            # injected dispatch failures take the SAME except path a real
+            # device error takes (nothing below special-cases injection)
+            self._faults.check_step_dispatch()
+        if self.paged:
+            # the pool rides the dispatch donated (argnums pin it); the
+            # TABLES ride as a non-donated input — they only change at
+            # admission, between dispatches, so the burst reads one
+            # consistent map for its whole scan
+            # graftlint: disable=use-after-donate -- paged _make_step donates argnums (1, 3): the pool and last_logits; self._tables at position 2 is a plain input (the dense maker's (1, 2) map does not apply to this call)
+            (
+                self._pool,
+                self._last_logits,
+                self._lens,
+                self._active_dev,
+                self._remaining_dev,
+                self._key,
+                tokens,
+                masks,
+                bads,
+            ) = fn(
+                self._variables, self._pool, self._tables, self._last_logits,
+                self._lens, self._active_dev, self._remaining_dev, self._key,
+                self._temp_dev, self._top_k_dev, self._top_p_dev,
+            )
+        else:
+            (
+                self._cache,
+                self._last_logits,
+                self._lens,
+                self._active_dev,
+                self._remaining_dev,
+                self._key,
+                tokens,
+                masks,
+                bads,
+            ) = fn(
+                self._variables, self._cache, self._last_logits, self._lens,
+                self._active_dev, self._remaining_dev, self._key,
+                self._temp_dev, self._top_k_dev, self._top_p_dev,
+            )
+        return tokens, masks, bads, lookahead
+
     def step(self, lookahead: int = 1) -> List[StepEvent]:  # graftlint: hot-path
         """Decode for every active slot; returns per-slot events.
 
@@ -2446,54 +2509,10 @@ class DecodeEngine:
         # device-resident mirrors (refreshed in _activate/cancel/reset), so a
         # steady-state tick performs ZERO host→device transfers (pinned by the
         # transfer-guard regression test).
-        sampling = bool((self._slot_temp[self._active] > 0).any())
-        fn = self._step_fns.get((lookahead, sampling))
-        if fn is None:
-            fn = self._step_fns[(lookahead, sampling)] = self._make_step(lookahead, sampling)
         t0 = time.perf_counter()
         device_was_idle = self._inflight is None
         try:
-            if self._faults is not None:
-                # injected dispatch failures take the SAME except path a real
-                # device error takes (nothing below special-cases injection)
-                self._faults.check_step_dispatch()
-            if self.paged:
-                # the pool rides the dispatch donated (argnums pin it); the
-                # TABLES ride as a non-donated input — they only change at
-                # admission, between dispatches, so the burst reads one
-                # consistent map for its whole scan
-                # graftlint: disable=use-after-donate -- paged _make_step donates argnums (1, 3): the pool and last_logits; self._tables at position 2 is a plain input (the dense maker's (1, 2) map does not apply to this call)
-                (
-                    self._pool,
-                    self._last_logits,
-                    self._lens,
-                    self._active_dev,
-                    self._remaining_dev,
-                    self._key,
-                    tokens,
-                    masks,
-                    bads,
-                ) = fn(
-                    self._variables, self._pool, self._tables, self._last_logits,
-                    self._lens, self._active_dev, self._remaining_dev, self._key,
-                    self._temp_dev, self._top_k_dev, self._top_p_dev,
-                )
-            else:
-                (
-                    self._cache,
-                    self._last_logits,
-                    self._lens,
-                    self._active_dev,
-                    self._remaining_dev,
-                    self._key,
-                    tokens,
-                    masks,
-                    bads,
-                ) = fn(
-                    self._variables, self._cache, self._last_logits, self._lens,
-                    self._active_dev, self._remaining_dev, self._key,
-                    self._temp_dev, self._top_k_dev, self._top_p_dev,
-                )
+            tokens, masks, bads, lookahead = self._dispatch_step(lookahead)
         except Exception:
             self._on_failure()
             raise
@@ -3291,6 +3310,27 @@ class ContinuousBatcher:
         if ticket.resume is not None:
             self._engine.release_preempted(ticket.resume)
             ticket.resume = None
+        if hasattr(self._engine, "note_request_class"):
+            from unionml_tpu.serving.scheduler import class_name
+
+            # label the slot for the per-class acceptance gauge
+            self._engine.note_request_class(slot, class_name(ticket.priority))
+
+    def _spec_sampling(self, ticket: Any) -> Optional[Dict[str, Any]]:
+        """The ticket's sampling dict with the per-class speculation default
+        applied (``SchedulerConfig.speculative_classes``); a client's explicit
+        ``speculative`` always wins, and engines without a speculative mode get
+        the dict untouched (they reject unknown keys)."""
+        if not hasattr(self._engine, "speculation_stats"):
+            return ticket.sampling
+        from unionml_tpu.serving.scheduler import class_name
+
+        sampling = dict(ticket.sampling or {})
+        sampling.setdefault(
+            "speculative",
+            class_name(ticket.priority) in self.scheduler.config.speculative_classes,
+        )
+        return sampling
 
     def _admit_batch(self, admissible: List[Any]) -> bool:  # graftlint: off-path (admission, not steady-state decode)
         """Admit popped tickets with per-request failure attribution.
@@ -3305,7 +3345,7 @@ class ContinuousBatcher:
         failures_before = getattr(self._engine, "failure_count", 0)
         try:
             slots = self._engine.admit_many(
-                [(t.prompt, t.budget, t.sampling) for t in admissible]
+                [(t.prompt, t.budget, self._spec_sampling(t)) for t in admissible]
             )
         except Exception as exc:
             if getattr(self._engine, "failure_count", 0) != failures_before:
@@ -3324,7 +3364,7 @@ class ContinuousBatcher:
                 failures_before = getattr(self._engine, "failure_count", 0)
                 try:
                     (slot,) = self._engine.admit_many(
-                        [(ticket.prompt, ticket.budget, ticket.sampling)]
+                        [(ticket.prompt, ticket.budget, self._spec_sampling(ticket))]
                     )
                 except Exception as one_exc:
                     if getattr(self._engine, "failure_count", 0) != failures_before:

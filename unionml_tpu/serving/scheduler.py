@@ -138,6 +138,13 @@ class SchedulerConfig:
     :param fifo: degrade to pure arrival order — priorities, aging, and
         preemption are ignored (deadlines and the queue bound still apply).
         The control arm of the scheduler-vs-FIFO bench A/B.
+    :param speculative_classes: request classes that decode speculatively when
+        the engine supports it (:class:`~unionml_tpu.serving.speculative.
+        SpeculativeEngine`). Speculation is an ITL play — it spends draft
+        compute to shorten per-token latency — so it defaults ON for
+        ``interactive`` only: ``batch`` traffic wants plain throughput, and
+        ``standard`` sits wherever the operator's bench says. A request's own
+        ``sampling={"speculative": ...}`` always overrides the class default.
     """
 
     max_queue: int = 256
@@ -146,6 +153,7 @@ class SchedulerConfig:
     shed_infeasible: bool = True
     retry_after_s: float = 1.0
     fifo: bool = False
+    speculative_classes: Tuple[str, ...] = ("interactive",)
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: queue membership, not field equality

@@ -301,6 +301,28 @@ class Telemetry:
             "Error-budget burn rate per class and rolling window",
             ("cls", "window"),
         )
+        # speculative decoding (ISSUE 16): acceptance EMA per SLO class and the
+        # live mean γ tell at a glance whether speculation is paying (α high,
+        # γ ramped) or has adaptively degraded to vanilla (γ → 0); the raw
+        # proposed/accepted counters give the exact accepted-tokens-per-
+        # target-step the bench gates on: (accepted + rounds) / rounds
+        self.spec_acceptance = m.gauge(
+            "unionml_spec_acceptance",
+            "Speculative acceptance EMA (mean over live speculative slots) per class",
+            ("cls",),
+        )
+        self.spec_gamma = m.gauge(
+            "unionml_spec_gamma",
+            "Current adaptive gamma (mean over live speculative slots)",
+        )
+        self.spec_proposed_total = m.counter(
+            "unionml_spec_proposed_total",
+            "Draft tokens proposed by speculative rounds",
+        )
+        self.spec_accepted_total = m.counter(
+            "unionml_spec_accepted_total",
+            "Draft proposals accepted by target verification",
+        )
 
     # ------------------------------------------------------------------ traces
 

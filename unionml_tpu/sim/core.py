@@ -367,7 +367,8 @@ class FleetSimulator:
                 rep.allocate(demand)
                 entry.demand = demand
                 service_ms = self.config.cost.service_ms(
-                    len(entry.request.prompt), entry.request.budget, entry.request.cls
+                    len(entry.request.prompt), entry.request.budget, entry.request.cls,
+                    speculative=getattr(entry.request, "speculative", False),
                 )
             entry.replica = rep.index
             entry.admit_t = now

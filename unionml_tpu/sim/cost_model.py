@@ -12,6 +12,16 @@ a request into:
   fuller batches and measures slower than interactive.
 - **dispatch**: fixed per-admission overhead (slot bind + first dispatch).
 
+Speculative decoding enters as a multiplicative ITL scale, α-parameterized
+after the standard speculative-sampling analysis: a round of γ draft
+proposals plus one verify emits ``E[tokens] = (1 − α^(γ+1)) / (1 − α)``
+tokens per target step (α the per-token acceptance rate) at relative cost
+``γ·ρ + 1`` (ρ the draft/target step-cost ratio), so a speculative
+request's ITL scales by ``(γ·ρ + 1) / E[tokens]``. The scale is clamped
+at 1.0 because the production engine's γ is *adaptive* — acceptance EMAs
+below threshold decay γ to 0 (vanilla decode), so speculation never runs
+slower than the baseline; a static-γ model would not earn that clamp.
+
 :func:`fit_cost_model` estimates all of it from journaled ``ok`` records
 using medians (robust to the heavy right tail every serving latency
 distribution has): prefill compute per request is recovered as
@@ -55,6 +65,10 @@ class CostModel:
     itl_ms: float = 8.0
     itl_ms_by_class: Dict[str, float] = field(default_factory=dict)
     dispatch_ms: float = 1.0
+    spec_alpha: float = 0.0  # 0 disables the speculative term entirely
+    spec_alpha_by_class: Dict[str, float] = field(default_factory=dict)
+    spec_gamma: int = 4
+    spec_draft_cost_ratio: float = 0.15  # ρ: draft step cost / target step cost
 
     def __post_init__(self) -> None:
         for name, value in (
@@ -62,9 +76,18 @@ class CostModel:
             ("prefill_ms_per_token", self.prefill_ms_per_token),
             ("itl_ms", self.itl_ms),
             ("dispatch_ms", self.dispatch_ms),
+            ("spec_draft_cost_ratio", self.spec_draft_cost_ratio),
         ):
             if value < 0:
                 raise ValueError(f"{name} must be >= 0, got {value}")
+        for cls, alpha in [(None, self.spec_alpha)] + list(
+            self.spec_alpha_by_class.items()
+        ):
+            if not 0.0 <= alpha < 1.0:
+                where = f"spec_alpha_by_class[{cls!r}]" if cls else "spec_alpha"
+                raise ValueError(f"{where} must be in [0, 1), got {alpha}")
+        if self.spec_gamma < 0:
+            raise ValueError(f"spec_gamma must be >= 0, got {self.spec_gamma}")
 
     def prefill_ms(self, tokens_in: int) -> float:
         return self.prefill_base_ms + self.prefill_ms_per_token * max(0, int(tokens_in))
@@ -74,14 +97,38 @@ class CostModel:
         real scheduler measures for itself inside the simulator)."""
         return self.dispatch_ms + self.prefill_ms(tokens_in)
 
-    def decode_ms(self, tokens_out: int, cls: str = "standard") -> float:
+    def spec_itl_scale(self, cls: str = "standard") -> float:
+        """ITL multiplier for a speculative request of class ``cls``:
+        ``min(1, (γ·ρ + 1) / E[tokens])`` with
+        ``E[tokens] = (1 − α^(γ+1)) / (1 − α)`` — the clamp models the
+        engine's adaptive γ decaying to vanilla on hostile traffic."""
+        alpha = self.spec_alpha_by_class.get(cls, self.spec_alpha)
+        if alpha <= 0.0 or self.spec_gamma == 0:
+            return 1.0
+        expected_tokens = (1.0 - alpha ** (self.spec_gamma + 1)) / (1.0 - alpha)
+        round_cost = self.spec_gamma * self.spec_draft_cost_ratio + 1.0
+        return min(1.0, round_cost / expected_tokens)
+
+    def decode_ms(
+        self, tokens_out: int, cls: str = "standard", speculative: bool = False
+    ) -> float:
         itl = self.itl_ms_by_class.get(cls, self.itl_ms)
+        if speculative:
+            itl *= self.spec_itl_scale(cls)
         # first token is priced by prefill; each FURTHER token costs one ITL
         return itl * max(0, int(tokens_out) - 1)
 
-    def service_ms(self, tokens_in: int, tokens_out: int, cls: str = "standard") -> float:
+    def service_ms(
+        self,
+        tokens_in: int,
+        tokens_out: int,
+        cls: str = "standard",
+        speculative: bool = False,
+    ) -> float:
         """Slot-occupancy time for one admitted request (no queue wait)."""
-        return self.ttft_compute_ms(tokens_in) + self.decode_ms(tokens_out, cls)
+        return self.ttft_compute_ms(tokens_in) + self.decode_ms(
+            tokens_out, cls, speculative
+        )
 
 
 def fit_cost_model(
@@ -131,4 +178,10 @@ def fit_cost_model(
         itl_ms=round(_median(all_itl), 4) if all_itl else default.itl_ms,
         itl_ms_by_class=itl_fit,
         dispatch_ms=default.dispatch_ms,
+        # journals do not record acceptance; the speculative term rides the
+        # defaults through so a CLI-chosen alpha survives the fit
+        spec_alpha=default.spec_alpha,
+        spec_alpha_by_class=default.spec_alpha_by_class,
+        spec_gamma=default.spec_gamma,
+        spec_draft_cost_ratio=default.spec_draft_cost_ratio,
     )

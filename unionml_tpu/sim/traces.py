@@ -56,6 +56,7 @@ class SimRequest:
     budget: int
     cls: str
     deadline_ms: Optional[float]
+    speculative: bool = False
 
 
 def _default_class_mix() -> Dict[str, float]:
@@ -99,6 +100,10 @@ class SyntheticConfig:
     deadline_ms_by_class: Dict[str, Optional[float]] = field(
         default_factory=_default_deadlines
     )
+    # classes served speculatively (mirrors SchedulerConfig.speculative_classes:
+    # the ITL play is for latency-sensitive traffic; the flag only has an
+    # effect when the CostModel's spec_alpha term is enabled)
+    speculative_classes: Tuple[str, ...] = ("interactive",)
     deaths: Tuple[ReplicaDeath, ...] = ()
     seed: int = 0
 
@@ -202,6 +207,7 @@ def generate_requests(config: SyntheticConfig) -> List[SimRequest]:
                     budget=budget,
                     cls=cls,
                     deadline_ms=config.deadline_ms_by_class.get(cls),
+                    speculative=cls in config.speculative_classes,
                 )
             )
     requests.sort(key=lambda r: r.arrival_s)
