@@ -331,6 +331,179 @@ def correctness_sweep_packed_cpu(shapes, candidates):
     return results
 
 
+def _paged_operands(batch, width, bs, heads, hd, quantized, dtype):
+    """Pool + table + bases for one paged decode shape: each row owns ``width``
+    contiguous pool blocks (plus the shared trailing scratch block) and decodes
+    its last position — the steady-state serving step."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    blocks = batch * width + 1
+    if quantized:
+        k = jnp.asarray(rng.integers(-127, 128, (blocks, heads, bs, hd)), jnp.int8)
+        v = jnp.asarray(rng.integers(-127, 128, (blocks, heads, bs, hd)), jnp.int8)
+        ks = jnp.asarray(rng.uniform(0.005, 0.02, (blocks, heads, 1, 1)), jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.005, 0.02, (blocks, heads, 1, 1)), jnp.float32)
+    else:
+        k = jnp.asarray(rng.normal(size=(blocks, heads, bs, hd)), dtype)
+        v = jnp.asarray(rng.normal(size=(blocks, heads, bs, hd)), dtype)
+        ks = vs = None
+    q = jnp.asarray(rng.normal(size=(batch, heads, 1, hd)), dtype)
+    table = jnp.asarray(
+        np.arange(batch * width, dtype=np.int32).reshape(batch, width)
+    )
+    base = jnp.full((batch,), width * bs - 1, jnp.int32)
+    return q, k, v, table, base, ks, vs
+
+
+def sweep_paged_tpu(shapes, head_candidates):
+    """Paged-decode arm on hardware: fused kernel (heads-per-step sweep) vs the
+    XLA gather-dequant-attend arm, int8 AND dense pools, per pool shape."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from unionml_tpu.ops.paged_attention import (
+        _paged_forward,
+        fused_hbm_bytes,
+        gather_hbm_bytes,
+        xla_paged_attention,
+    )
+
+    SCAN_N = 64  # decode launches are microseconds: time a chained scan
+
+    def scanned(fn):
+        @jax.jit
+        def run(q, *rest):
+            def body(c, _):
+                return fn(c, *rest), None
+
+            return jax.lax.scan(body, q, None, length=SCAN_N)[0]
+
+        return run
+
+    results = {}
+    for batch, width, bs, heads, hd in shapes:
+        for quantized in (True, False):
+            q, k, v, table, base, ks, vs = _paged_operands(
+                batch, width, bs, heads, hd, quantized, jnp.bfloat16
+            )
+            name = f"w{width}_bs{bs}_h{heads}_d{hd}_{'int8' if quantized else 'bf16'}"
+            xla_fn = scanned(
+                lambda c, k, v, t, b, ks, vs: xla_paged_attention(
+                    c, k, v, t, b, k_scale=ks, v_scale=vs, out_dtype=c.dtype
+                )
+            )
+            xla_ms = _time(xla_fn, q, k, v, table, base, ks, vs, iters=8, reps=5) / SCAN_N
+            rows, best = [], None
+            for gh in head_candidates:
+                if heads % gh:
+                    continue
+                fused = scanned(
+                    functools.partial(
+                        lambda c, k, v, t, b, ks, vs, gh: _paged_forward(
+                            c, k, v, t, b, ks, vs, c.dtype, gh, False
+                        ),
+                        gh=gh,
+                    )
+                )
+                try:
+                    ms = _time(fused, q, k, v, table, base, ks, vs, iters=8, reps=5) / SCAN_N
+                except Exception as exc:  # Mosaic lowering failure at this tiling
+                    rows.append({"heads_per_step": gh, "error": str(exc)[:200]})
+                    continue
+                rows.append({"heads_per_step": gh, "fwd_ms": round(ms, 5)})
+                if best is None or ms < best["fwd_ms"]:
+                    best = rows[-1]
+            results[name] = {
+                "xla_fwd_ms": round(xla_ms, 5),
+                "sweep": rows,
+                "best": best,
+                "verdict": (
+                    "use_pallas" if best and best["fwd_ms"] < xla_ms else "use_xla"
+                ) if best is not None else "pallas_failed_use_xla",
+                "fused_hbm_bytes": fused_hbm_bytes(width, bs, heads, hd, quantized),
+                "gather_hbm_bytes": gather_hbm_bytes(width, bs, heads, hd, quantized),
+            }
+            print(f"[paged] {name}: xla {xla_ms:.5f}ms best "
+                  f"{best['fwd_ms'] if best else float('nan'):.5f}ms "
+                  f"-> {results[name]['verdict']}", file=sys.stderr)
+    return results
+
+
+def correctness_sweep_paged_cpu(shapes, head_candidates):
+    """CPU fallback for --paged: interpret-mode parity per heads-per-step
+    tiling, both pool dtypes, against the XLA gather reference."""
+    import jax.numpy as jnp
+
+    from unionml_tpu.ops.paged_attention import (
+        _paged_forward,
+        fused_hbm_bytes,
+        gather_hbm_bytes,
+        xla_paged_attention,
+    )
+
+    results = {}
+    for batch, width, bs, heads, hd in shapes:
+        for quantized in (True, False):
+            q, k, v, table, base, ks, vs = _paged_operands(
+                batch, width, bs, heads, hd, quantized, jnp.float32
+            )
+            name = f"w{width}_bs{bs}_h{heads}_d{hd}_{'int8' if quantized else 'f32'}"
+            ref = xla_paged_attention(
+                q, k, v, table, base, k_scale=ks, v_scale=vs, out_dtype=jnp.float32
+            )
+            rows = []
+            for gh in head_candidates:
+                if heads % gh:
+                    continue
+                out = _paged_forward(
+                    q, k, v, table, base, ks, vs, jnp.float32, gh, True
+                )
+                err = float(jnp.max(jnp.abs(out - ref)))
+                rows.append({"heads_per_step": gh, "max_err": err, "ok": err < 1e-4})
+            results[name] = {
+                "mode": "cpu-interpret-correctness-only",
+                "sweep": rows,
+                "all_ok": all(r["ok"] for r in rows),
+                "fused_hbm_bytes": fused_hbm_bytes(width, bs, heads, hd, quantized),
+                "gather_hbm_bytes": gather_hbm_bytes(width, bs, heads, hd, quantized),
+            }
+            print(f"[paged] {name}: {len(rows)} tilings validated, "
+                  f"all_ok={results[name]['all_ok']}", file=sys.stderr)
+    return results
+
+
+def gate_paged_traffic(shapes):
+    """ISSUE-18 acceptance gate: the fused kernel's modeled HBM bytes/step must
+    be EXACTLY the stored codes + scales — the dense gather copy provably gone
+    from the traffic model. Returns the gate rows; raises SystemExit on excess."""
+    from unionml_tpu.ops.paged_attention import fused_hbm_bytes, gather_hbm_bytes
+
+    rows = []
+    for batch, width, bs, heads, hd in shapes:
+        for quantized in (True, False):
+            kv_positions = 2 * width * bs * heads * hd
+            codes = kv_positions * (1 if quantized else 2)
+            scales = 2 * width * heads * 4 if quantized else 0
+            fused = fused_hbm_bytes(width, bs, heads, hd, quantized)
+            rows.append({
+                "width": width, "block_size": bs, "heads": heads, "head_dim": hd,
+                "quantized": quantized, "fused_hbm_bytes": fused,
+                "codes_plus_scales": codes + scales,
+                "gather_hbm_bytes": gather_hbm_bytes(width, bs, heads, hd, quantized),
+            })
+            if fused > codes + scales:
+                print(f"[paged] TRAFFIC GATE FAILED: fused model reads {fused} "
+                      f"bytes/step but codes+scales are {codes + scales} "
+                      f"(w={width} bs={bs} h={heads} d={hd} int8={quantized})",
+                      file=sys.stderr)
+                raise SystemExit(1)
+    return rows
+
+
 def correctness_sweep_cpu(shapes, candidates):
     """CPU fallback: validate every block config numerically in interpret mode."""
     import jax
@@ -385,6 +558,7 @@ def main():
     import jax
 
     packed_mode = "--packed" in sys.argv
+    paged_mode = "--paged" in sys.argv
     backend = jax.default_backend()
     # BERT-base fine-tune shapes + mid/long sequences + a head_dim-128 family
     # (GPT-2 context at 1024; 128-dim heads cover larger decoder configs)
@@ -397,7 +571,27 @@ def main():
     ]
     candidates = (128, 256, 512)
 
-    if packed_mode:
+    if paged_mode:
+        # paged decode pool shapes (batch, table_width, block_size, heads, head_dim):
+        # pool-size sweep over the table width at serving-typical head layouts
+        paged_shapes = [
+            (8, 8, 16, 12, 64),
+            (8, 16, 16, 12, 64),
+            (8, 32, 16, 12, 64),
+            (4, 16, 16, 16, 128),
+        ]
+        head_candidates = (1, 2, 4)
+        if backend == "cpu":
+            paged_shapes = [(2, 4, 4, 2, 16), (2, 6, 4, 4, 16)]
+            results = correctness_sweep_paged_cpu(paged_shapes, head_candidates)
+            payload = {"backend": backend, "timing_valid": False, "results": results}
+        else:
+            results = sweep_paged_tpu(paged_shapes, head_candidates)
+            payload = {"backend": backend, "timing_valid": True, "results": results}
+        # the acceptance gate runs in BOTH modes: the traffic model is static
+        payload["traffic_gate"] = gate_paged_traffic(paged_shapes)
+        out_path, metric = "PAGED_KERNEL_BENCH.json", "paged_kernel_sweep"
+    elif packed_mode:
         # packed training shapes (GPT: causal + segment ids)
         shapes = [(8, 12, 128, 64), (4, 12, 512, 64), (2, 12, 1024, 64)]
         if backend == "cpu":

@@ -1,0 +1,242 @@
+"""Fused paged-attention kernel: interpret-mode parity + int8 edge cases.
+
+Kernel level: the pallas arm (interpret mode on CPU) against the XLA gather
+reference — random pools first, then the three int8 edge shapes the pool
+discipline actually produces: an EMPTY block (scale 0), a freshly RESCALED
+tail block after a monotone scale grow, and a SPLICED shared-prefix block
+borrowed at a non-zero table offset. The two arms attend over bit-identical
+dequantized values and differ only in summation order (online-softmax over
+blocks vs one dense softmax), so values are pinned tight but not bitwise;
+what IS bitwise is each arm's invariance to content the contract says cannot
+matter (masked columns, scale-0 codes, pool indirection).
+
+Engine level: forcing ``paged_attn_impl="pallas"`` through the real decode /
+chunked-prefill / speculative-verify programs produces TOKEN-IDENTICAL
+streams to the XLA arm — greedy and fixed-seed sampled, f32 and int8 pools,
+1- and 4-device meshes — and the steady state stays transfer-guard clean
+with telemetry on (zero host→device uploads, ISSUE-18 acceptance).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from unionml_tpu.models.gpt import GPTLMHeadModel, _paged_append_quantized
+from unionml_tpu.ops.paged_attention import paged_attention, xla_paged_attention
+from unionml_tpu.parallel import make_mesh
+from unionml_tpu.serving.continuous import DecodeEngine
+
+from tests.unit.test_paged_kv import BS, mixed_schedule
+
+HEADS, HD = 2, 16
+
+
+# --------------------------------------------------------------- kernel level
+
+
+def _rand_pool(seed, blocks, bs, *, quantized):
+    """A filled pool: int8 codes + positive per-(block, head) scales, or f32."""
+    rng = np.random.default_rng(seed)
+    if quantized:
+        k = jnp.asarray(rng.integers(-127, 128, (blocks, HEADS, bs, HD)), jnp.int8)
+        v = jnp.asarray(rng.integers(-127, 128, (blocks, HEADS, bs, HD)), jnp.int8)
+        ks = jnp.asarray(rng.uniform(0.005, 0.02, (blocks, HEADS, 1, 1)), jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.005, 0.02, (blocks, HEADS, 1, 1)), jnp.float32)
+        return k, v, ks, vs
+    k = jnp.asarray(rng.normal(size=(blocks, HEADS, bs, HD)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(blocks, HEADS, bs, HD)), jnp.float32)
+    return k, v, None, None
+
+
+def _q(seed, batch, S=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(batch, HEADS, S, HD)), jnp.float32)
+
+
+def _both(q, k, v, table, base, ks=None, vs=None):
+    args = dict(k_scale=ks, v_scale=vs, out_dtype=jnp.float32)
+    ref = paged_attention(q, k, v, table, base, impl="xla", **args)
+    out = paged_attention(q, k, v, table, base, impl="pallas", **args)
+    return np.asarray(ref), np.asarray(out)
+
+
+@pytest.mark.parametrize("quantized", [False, True], ids=["f32", "int8"])
+def test_kernel_matches_xla_reference(quantized):
+    """Random pool, ragged bases, decode (S=1) and chunk (S>1) shapes."""
+    k, v, ks, vs = _rand_pool(0, blocks=9, bs=BS, quantized=quantized)
+    table = jnp.asarray([[0, 1, 2, 8], [3, 4, 8, 8], [5, 6, 7, 8]], jnp.int32)
+    base = jnp.asarray([11, 5, 9], jnp.int32)  # ragged live lengths
+    ref, out = _both(_q(1, 3), k, v, table, base, ks, vs)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    # batch-1 chunk: S query tokens at consecutive positions (prefill shape)
+    ref, out = _both(_q(2, 1, S=6), k, v, table[:1], base[:1] - 4, ks, vs)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_empty_block_scale_zero_is_inert():
+    """Edge 1: an allocated-but-unwritten block (scale 0, arbitrary stale
+    codes). Within the live range it must dequantize to exact zeros; past the
+    base it is masked entirely. Either way the CODES cannot matter: flipping
+    every stale byte leaves the kernel output bit-identical, and both arms
+    agree on the attended values."""
+    k, v, ks, vs = _rand_pool(3, blocks=6, bs=BS, quantized=True)
+    empty = 4
+    ks = ks.at[empty].set(0.0)
+    vs = vs.at[empty].set(0.0)
+    q = _q(4, 2)
+    table = jnp.asarray([[0, 1, empty, 5], [2, empty, 3, 5]], jnp.int32)
+    # row 0: empty block sits PAST base (masked); row 1: empty block sits
+    # INSIDE the live range (scale-0 zeros participate in the softmax)
+    base = jnp.asarray([2 * BS - 1, 3 * BS - 1], jnp.int32)
+
+    ref, out = _both(q, k, v, table, base, ks, vs)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    stale = jnp.full(k.shape[1:], 93, jnp.int8)  # flip every stale byte
+    k2, v2 = k.at[empty].set(stale), v.at[empty].set(-stale)
+    ref2, out2 = _both(q, k2, v2, table, base, ks, vs)
+    np.testing.assert_array_equal(out2, out)
+    np.testing.assert_array_equal(ref2, ref)
+
+
+def test_rescaled_tail_block_after_monotone_grow():
+    """Edge 2: a tail block built by the REAL append arithmetic, with a loud
+    token forcing a mid-block scale grow (old codes requantized to the new,
+    strictly larger scale). Both arms attend the requantized codes through the
+    same dequant expression, so parity must hold on the exact bytes the pool
+    discipline produces — not on synthetic well-scaled data."""
+    k, v, ks, vs = _rand_pool(5, blocks=5, bs=BS, quantized=True)
+    tail = 3
+    rng = np.random.default_rng(6)
+    dst = jnp.asarray([tail], jnp.int32)
+    scale_log = []
+    for off in range(BS):
+        amp = 4.0 if off == 2 else 0.5  # off=2 is ~8x louder: forces the grow
+        tok = jnp.asarray(amp * rng.normal(size=(1, HEADS, HD)), jnp.float32)
+        k, ks = _paged_append_quantized(k, ks, dst, jnp.asarray([off], jnp.int32), tok)
+        v, vs = _paged_append_quantized(v, vs, dst, jnp.asarray([off], jnp.int32), tok)
+        scale_log.append(np.asarray(ks[tail, :, 0, 0]))
+    # the discipline under test: per-head scales never shrank across appends
+    for prev, cur in zip(scale_log, scale_log[1:]):
+        assert (cur >= prev - 1e-12).all()
+    assert (scale_log[2] > scale_log[1]).any()  # the loud token DID grow it
+
+    table = jnp.asarray([[0, 1, 2, tail]], jnp.int32)
+    ref, out = _both(_q(7, 1), k, v, table, jnp.asarray([4 * BS - 1]), ks, vs)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_spliced_shared_block_at_nonzero_offset():
+    """Edge 3: a shared-prefix block borrowed by another row at a NON-ZERO
+    table column. The kernel walks each row's table independently, so sharing
+    must be pure indirection: duplicating the shared block into a private copy
+    changes nothing, bitwise, in either arm."""
+    k, v, ks, vs = _rand_pool(8, blocks=8, bs=BS, quantized=True)
+    shared, spare = 0, 6
+    table = jnp.asarray([[shared, 1, 2, 7], [3, shared, 4, 7]], jnp.int32)
+    base = jnp.asarray([3 * BS - 1, 3 * BS - 1], jnp.int32)
+    q = _q(9, 2)
+
+    ref, out = _both(q, k, v, table, base, ks, vs)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    # physically duplicate the shared block for row 1: identical output bytes
+    k2 = k.at[spare].set(k[shared])
+    v2 = v.at[spare].set(v[shared])
+    ks2 = ks.at[spare].set(ks[shared])
+    vs2 = vs.at[spare].set(vs[shared])
+    table2 = jnp.asarray([[shared, 1, 2, 7], [3, spare, 4, 7]], jnp.int32)
+    ref2, out2 = _both(q, k2, v2, table2, base, ks2, vs2)
+    np.testing.assert_array_equal(out2, out)
+    np.testing.assert_array_equal(ref2, ref)
+
+
+def test_impl_validation():
+    k, v, ks, vs = _rand_pool(0, blocks=2, bs=BS, quantized=True)
+    with pytest.raises(ValueError, match="impl"):
+        paged_attention(_q(0, 1), k, v, jnp.zeros((1, 1), jnp.int32),
+                        jnp.zeros((1,), jnp.int32), impl="cuda")
+    with pytest.raises(ValueError, match="together"):
+        paged_attention(_q(0, 1), k, v, jnp.zeros((1, 1), jnp.int32),
+                        jnp.zeros((1,), jnp.int32), k_scale=ks)
+
+
+# --------------------------------------------------------------- engine level
+
+
+ENGINE_KW = dict(
+    num_slots=4, max_len=64, prefill_buckets=(4, 8, 16), prefill_chunk=4,
+    prefix_cache_blocks=24, prefix_block_size=BS, seed=0, temperature=0.0,
+)
+
+
+def _engine(gpt_tiny_session, impl, *, mesh=None, **kw):
+    """A paged engine whose model config pins the decode-attention backend
+    (same variables — the weights don't know which kernel attends them)."""
+    config, _, variables = gpt_tiny_session
+    model = GPTLMHeadModel(dataclasses.replace(config, paged_attn_impl=impl))
+    return DecodeEngine(model, variables, paged=True, mesh=mesh,
+                        **dict(ENGINE_KW, **kw))
+
+
+@pytest.mark.parametrize("kv", [None, "int8"], ids=["f32pool", "int8pool"])
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "sampled"])
+def test_engine_kernel_token_parity(gpt_tiny_session, kv, sampled):
+    """Fused kernel == XLA arm, token for token, through the full mixed
+    schedule (miss, splice hit, chunked prefill, mid-flight cancel, replay)."""
+    streams = {}
+    for impl in ("xla", "pallas"):
+        eng = _engine(gpt_tiny_session, impl, kv_quantize=kv)
+        streams[impl], _ = mixed_schedule(eng, sampled=sampled)
+    assert streams["pallas"] == streams["xla"]
+
+
+def test_engine_kernel_token_parity_mesh4(gpt_tiny_session):
+    """Same gate under a 4-device tensor mesh (int8 pool): the kernel runs
+    shard-local inside the pjit program on every device."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices (conftest forces 8 CPU devices)")
+    streams = {}
+    for impl in ("xla", "pallas"):
+        mesh = make_mesh({"tensor": 4}, devices=jax.devices()[:4])
+        eng = _engine(gpt_tiny_session, impl, mesh=mesh, kv_quantize="int8")
+        streams[impl], _ = mixed_schedule(eng, sampled=False)
+    assert streams["pallas"] == streams["xla"]
+
+
+def test_spec_verify_token_parity(gpt_tiny_session):
+    """Speculative schedule: the S-token paged VERIFY path also dispatches to
+    the kernel; spec engines on either backend emit identical streams."""
+    from unionml_tpu.serving.speculative import SpeculativeEngine
+
+    config, _, variables = gpt_tiny_session
+    streams = {}
+    for impl in ("xla", "pallas"):
+        model = GPTLMHeadModel(dataclasses.replace(config, paged_attn_impl=impl))
+        eng = SpeculativeEngine(model, variables, model, variables,
+                                **dict(ENGINE_KW, seed=7))
+        streams[impl], _ = mixed_schedule(eng, sampled=False)
+    assert streams["pallas"] == streams["xla"]
+
+
+def test_kernel_steady_state_transfer_guard_clean_with_telemetry(gpt_tiny_session):
+    """ISSUE-18 acceptance: with telemetry ON and the fused kernel forced, the
+    steady-state decode tick still pays ZERO host→device uploads — the kernel's
+    scalar-prefetch operands (table, bases) are the same device-resident
+    mirrors the XLA path reads, and the impl info gauge is host-only."""
+    from unionml_tpu.serving.telemetry import Telemetry
+
+    tel = Telemetry()
+    eng = _engine(gpt_tiny_session, "pallas", kv_quantize="int8", telemetry=tel)
+    eng.admit_many([([3, 1, 4, 1], 20, {}), ([2, 7, 1, 8], 20, {})])
+    eng.step()  # compile + warm outside the guard
+    eng.step()
+    with jax.transfer_guard_host_to_device("disallow"):
+        for _ in range(3):
+            eng.step()
+    rendered = tel.metrics.render()
+    assert 'unionml_paged_attn_impl{impl="pallas"} 1' in rendered

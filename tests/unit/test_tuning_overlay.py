@@ -41,6 +41,43 @@ def test_distill_promotes_only_timing_valid_and_safe(tmp_path):
     assert overlay["packed_tuned_blocks"] == {}
 
 
+def test_distill_paged_verdicts_and_heads(tmp_path):
+    """Paged sweep → rank-4 verdicts: ties break toward PALLAS (the byte-model
+    default), the int8 entry wins the shared dispatch key, and the winning
+    heads-per-step tiling rides along."""
+    from tools.promote_tuning import distill_paged
+
+    (tmp_path / "PAGED_KERNEL_BENCH.json").write_text(json.dumps({
+        "timing_valid": True,
+        "results": {
+            # dense says xla, int8 says pallas: int8 wins the shared key
+            "w16_bs16_h12_d64_bf16": {"verdict": "use_xla", "xla_fwd_ms": 0.5,
+                                      "best": {"heads_per_step": 1, "fwd_ms": 0.6}},
+            "w16_bs16_h12_d64_int8": {"verdict": "use_pallas", "xla_fwd_ms": 0.9,
+                                      "best": {"heads_per_step": 4, "fwd_ms": 0.4}},
+            # xla "won" by <2%: a tie, broken toward the paged default (pallas)
+            "w32_bs16_h12_d64_int8": {"verdict": "use_xla", "xla_fwd_ms": 0.99,
+                                      "best": {"heads_per_step": 2, "fwd_ms": 1.0}},
+            # kernel failed to lower at this shape: honest demotion
+            "w8_bs16_h16_d128_int8": {"verdict": "pallas_failed_use_xla"},
+        },
+    }))
+    overlay = distill_paged(tmp_path)
+    assert overlay["measured_paged_impl"] == {
+        "16,16,12,64": "pallas",
+        "32,16,12,64": "pallas",
+        "8,16,16,128": "xla",
+    }
+    assert overlay["paged_tuned_heads"]["16,16,12,64"] == 4
+
+    # a CPU correctness artifact contributes nothing
+    (tmp_path / "PAGED_KERNEL_BENCH.json").write_text(json.dumps({
+        "timing_valid": False,
+        "results": {"w16_bs16_h12_d64_int8": {"verdict": "use_pallas"}},
+    }))
+    assert distill_paged(tmp_path) == {"measured_paged_impl": {}, "paged_tuned_heads": {}}
+
+
 def test_promote_merges_with_existing_overlay(tmp_path):
     """A window with one failed sweep must not erase the other table's verdicts."""
     import sys
@@ -62,7 +99,7 @@ def test_promote_merges_with_existing_overlay(tmp_path):
     import unittest.mock as mock
 
     with mock.patch.object(promote_tuning, "REPO", tmp_path), \
-         mock.patch.object(promote_tuning, "distill", lambda: overlay):
+         mock.patch.object(promote_tuning, "distill", lambda *_: overlay):
         promote_tuning.main()
     merged = json.loads((tmp_path / "TUNING_MEASURED.json").read_text())
     assert merged["measured_packed_impl"] == {"512,512,64": "pallas"}  # preserved
@@ -77,6 +114,10 @@ def test_overlay_merges_into_tables(tmp_path, monkeypatch):
         "measured_packed_impl": {"128,128,64": "pallas"},
         "measured_impl": {"4096,4096,64": "pallas"},
         "tuned_blocks": {"4096,4096,64": [512, 512]},
+        # rank-4 paged tables, with malformed entries that must be dropped
+        "measured_paged_impl": {"16,16,12,64": "xla", "16,16,12": "pallas",
+                                "32,16,12,64": "cuda"},
+        "paged_tuned_heads": {"16,16,12,64": 4, "32,16,12,64": True},
     }
     path = tmp_path / "TUNING_MEASURED.json"
     path.write_text(json.dumps(overlay))
@@ -95,6 +136,11 @@ def test_overlay_merges_into_tables(tmp_path, monkeypatch):
         assert tuning.pick_packed_impl(512, 512, 64) == tuning.DEFAULT_PACKED_IMPL
         assert tuning.pick_impl(4096, 4096, 64) == "pallas"
         assert tuning.pick_block_sizes(4096, 4096, 64) == (512, 512)
+        # paged: the measured demotion lands; malformed keys/values are dropped
+        assert tuning.pick_paged_impl(16, 16, 12, 64) == "xla"
+        assert tuning.pick_paged_impl(32, 16, 12, 64) == tuning.DEFAULT_PAGED_IMPL
+        assert tuning.pick_paged_heads(16, 16, 12, 64) == 4
+        assert tuning.pick_paged_heads(32, 16, 12, 64) == 1  # bool rejected
     finally:
         monkeypatch.undo()
         importlib.reload(tuning)  # restore the real tables for later tests

@@ -40,6 +40,7 @@ MODULES = [
     ("unionml_tpu.serving.slo", "SLO objectives, attainment & burn rate"),
     ("unionml_tpu.sim", "Fleet simulator (replay, synthetic traces, autoscaler)"),
     ("unionml_tpu.ops.attention", "Attention ops"),
+    ("unionml_tpu.ops.paged_attention", "Paged attention (fused decode kernel)"),
     ("unionml_tpu.ops.sampling", "Sampling ops"),
     ("unionml_tpu.ops.quant", "Quantization ops"),
     ("unionml_tpu.stage", "Staged execution"),

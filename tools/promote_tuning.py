@@ -45,7 +45,68 @@ def _load(path: pathlib.Path):
     return payload.get("results", {})
 
 
-_TABLES = ("measured_impl", "measured_packed_impl", "tuned_blocks", "packed_tuned_blocks")
+_TABLES = (
+    "measured_impl",
+    "measured_packed_impl",
+    "tuned_blocks",
+    "packed_tuned_blocks",
+    "measured_paged_impl",
+    "paged_tuned_heads",
+)
+
+
+def _paged_shape_key(name: str):
+    # paged sweep keys look like "w16_bs16_h12_d64_int8"; the dtype suffix is
+    # not part of the dispatch key (one traced program serves both pools)
+    parts = {}
+    for p in name.split("_"):
+        if p.startswith("bs"):
+            parts["bs"] = p[2:]
+        elif p and p[0] in "whd" and p[1:].isdigit():
+            parts[p[0]] = p[1:]
+    try:
+        return "{w},{bs},{h},{d}".format(**{k: int(v) for k, v in parts.items()})
+    except (KeyError, ValueError):
+        return None
+
+
+def distill_paged(repo: pathlib.Path = REPO) -> dict:
+    """PAGED_KERNEL_BENCH.json → measured_paged_impl / paged_tuned_heads.
+
+    The paged default is PALLAS (the byte model carries the burden of proof the
+    other way — see ``tuning.DEFAULT_PAGED_IMPL``), so the tie margin demotes
+    toward pallas here: XLA must beat the kernel by >2% to claim the shape.
+    Both pool dtypes share one dispatch key; the int8 verdict wins conflicts
+    (it is the serving configuration the pool exists for)."""
+    overlay = {"measured_paged_impl": {}, "paged_tuned_heads": {}}
+    results = _load(repo / "PAGED_KERNEL_BENCH.json")
+    if results is None:
+        return overlay
+    # int8 entries last so they overwrite the dense verdict on key conflicts
+    for name in sorted(results, key=lambda n: n.endswith("int8")):
+        entry = results[name]
+        key = _paged_shape_key(name)
+        verdict = entry.get("verdict")
+        if key is None or verdict not in ("use_pallas", "use_xla", "pallas_failed_use_xla"):
+            continue
+        best = entry.get("best") or {}
+        xla_ms = entry.get("xla_fwd_ms")
+        if (
+            verdict == "use_xla"
+            and best
+            and xla_ms
+            and xla_ms > TIE_MARGIN * best.get("fwd_ms", float("inf"))
+        ):
+            print(f"[promote] paged {name}: xla within the tie margin "
+                  f"({xla_ms} vs {best.get('fwd_ms')}ms); keeping pallas",
+                  file=sys.stderr)
+            verdict = "use_pallas"
+        overlay["measured_paged_impl"][key] = (
+            "pallas" if verdict == "use_pallas" else "xla"
+        )
+        if "heads_per_step" in best:
+            overlay["paged_tuned_heads"][key] = best["heads_per_step"]
+    return overlay
 
 
 def distill(repo: pathlib.Path = REPO) -> dict:
@@ -88,7 +149,8 @@ def distill(repo: pathlib.Path = REPO) -> dict:
 
 
 def main():
-    overlay = distill()
+    overlay = distill(REPO)
+    overlay.update(distill_paged(REPO))
     if not any(overlay.values()):
         print("[promote] no timing-valid sweep artifacts; overlay unchanged", file=sys.stderr)
         return
@@ -109,7 +171,8 @@ def main():
         json.dump(merged, fh, indent=2, sort_keys=True)
     print(f"[promote] wrote {out}: "
           f"{len(merged['measured_impl'])} dense, "
-          f"{len(merged['measured_packed_impl'])} packed verdicts", file=sys.stderr)
+          f"{len(merged['measured_packed_impl'])} packed, "
+          f"{len(merged['measured_paged_impl'])} paged verdicts", file=sys.stderr)
 
 
 if __name__ == "__main__":

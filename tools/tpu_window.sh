@@ -97,7 +97,14 @@ run rebaseline 30 python tools/rebaseline.py /tmp/tpu_bench.out
 run mfu 700 python bench_mfu.py
 run kernels 900 python bench_kernels.py
 run packed 600 python bench_kernels.py --packed
-# distill sweep winners into the dispatch overlay (no-op without timing-valid runs)
+# paged_attn phase 1/3: fused paged-decode kernel sweep (heads-per-step tiling,
+# int8 + bf16 pools, pool-size spread) vs the XLA gather arm; the run also
+# enforces the HBM-traffic gate (fused bytes/step == codes + scales, nonzero
+# exit otherwise) -> PAGED_KERNEL_BENCH.json
+run paged_attn_sweep 600 python bench_kernels.py --paged
+# distill sweep winners (dense + packed + paged) into the dispatch overlay
+# (no-op without timing-valid runs); paged verdicts land in
+# measured_paged_impl / paged_tuned_heads keyed (width, block_size, heads, head_dim)
 run promote 60 python tools/promote_tuning.py
 run serving 600 python bench_serving.py --bert-base --speculative --prefill-heavy --prefix-heavy
 # tensor-parallel serving path (sharded DecodeEngine + batched/chunked prefill):
@@ -115,6 +122,12 @@ run serving_paged 300 python bench_serving.py --paged ab
 # paged pool AND the pinned logprob-delta/divergence quality budgets, gated
 # in the same run (exits nonzero on either failure)
 run serving_int8 300 python bench_serving.py --int8 ab
+# paged_attn phases 2/3 + 3/3: the overlay written by promote above is live in
+# this process tree (tuning loads it at import), so rerunning the int8 A/B now
+# measures the END-TO-END serving effect of the fused-kernel verdicts — the
+# measured speedup gate for ISSUE 18 (compare decode tok/s against the
+# serving_int8 row above; a regression means a bad verdict was promoted)
+run paged_attn_ab 300 python bench_serving.py --int8 ab
 # adaptive speculative decoding A/B on the paged int8 pool: spec-on vs the
 # gamma=0 arm at identical pool bytes — accepted-tokens-per-target-step
 # >= 1.4 in-distribution AND >= 0.95 on adversarial held-out traffic, with

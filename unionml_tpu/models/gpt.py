@@ -21,6 +21,7 @@ import numpy as np
 
 from unionml_tpu.models.moe import MoEMlp
 from unionml_tpu.ops.attention import attention, xla_attention
+from unionml_tpu.ops.paged_attention import paged_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +38,11 @@ class GPTConfig:
     #: sequence-parallel long-context TRAINING paths and require ``sp_mesh``
     #: (generation/KV-cache paths fall back to per-token attention)
     attention_impl: str = "auto"
+    #: paged DECODE attention backend ("auto" | "pallas" | "xla"): the fused
+    #: dequant-attend kernel vs the gather-dequant reference — see
+    #: :mod:`unionml_tpu.ops.paged_attention`. "auto" = pallas on TPU
+    #: (measured verdicts override per shape class), XLA elsewhere.
+    paged_attn_impl: str = "auto"
     #: mesh carrying a "sequence" axis for ring/ulysses attention
     sp_mesh: Any = None
     #: remat (jax.checkpoint) decoder blocks during TRAINING forwards: activations
@@ -148,7 +154,7 @@ def _paged_chunk_quantized(pool_q, pool_scale, table_row, position, vals):
     return pool_q.at[dst].set(new_q), pool_scale.at[dst].set(new_scale)
 
 
-def _paged_verify_chunk(cache, block_table, position, q, k, v, out_dtype):
+def _paged_verify_chunk(cache, block_table, position, q, k, v, out_dtype, impl="auto"):
     """(jit-traceable) Speculative verify: attention context for ``S`` chunk
     tokens per row over the row's paged prefix, WITHOUT writing the pool.
 
@@ -160,13 +166,16 @@ def _paged_verify_chunk(cache, block_table, position, q, k, v, out_dtype):
     BIT-IDENTICAL to feeding the chunk one token at a time through the decode
     append: each scan step mirrors the append arithmetic
     (:func:`_paged_append_quantized` / the fp ``.at[].set``) into a LOCAL
-    gathered copy of the row's blocks, dequantizes, and runs the same
-    ``(1, capacity)`` masked attention shape vanilla decode runs — so accepted
-    tokens score exactly as they would have under plain decoding, and the
-    engine's commit (:func:`paged_commit_chunk`) replays the same appends into
-    the real pool. The attention rows serialize over ``S`` (tiny, bandwidth-
-    equal to S vanilla steps); the win stays in the dense projections/MLP,
-    which batch all S tokens per dispatch.
+    gathered copy of the row's blocks — ``(batch, width, heads, bs, hd)``, the
+    pool's own block layout — and attends through
+    :func:`unionml_tpu.ops.paged_attention.paged_attention` over an identity
+    table, so the verify step runs the SAME per-block arithmetic (same
+    ``impl``) vanilla decode runs and accepted tokens score exactly as they
+    would have under plain decoding; the engine's commit
+    (:func:`paged_commit_chunk`) replays the same appends into the real pool.
+    The attention rows serialize over ``S`` (tiny, bandwidth-equal to S vanilla
+    steps); the win stays in the dense projections/MLP, which batch all S
+    tokens per dispatch.
     """
     batch, heads, S, head_dim = q.shape
     block_size = cache["k"].shape[2]
@@ -174,12 +183,17 @@ def _paged_verify_chunk(cache, block_table, position, q, k, v, out_dtype):
     capacity = width * block_size
     quantized = "k_scale" in cache
     b_idx = jnp.arange(batch)
-    k_pos = jnp.arange(capacity)
     pos0 = position.astype(jnp.int32)
+    # after the flatten below, row b's logical block w is local block b*width+w
+    local_table = (b_idx[:, None] * width + jnp.arange(width)[None, :]).astype(jnp.int32)
 
     def local(leaf):
-        # (batch, heads, width, bs, hd): the row's blocks, block structure kept
-        return jnp.moveaxis(leaf[block_table], 2, 1)
+        # (batch, width, heads, bs, hd): the row's blocks, block structure kept
+        return leaf[block_table]
+
+    def flat(x):
+        # the local state viewed as a (batch*width)-block pool for paged_attention
+        return x.reshape((batch * width,) + x.shape[2:])
 
     if quantized:
         state = (
@@ -192,8 +206,8 @@ def _paged_verify_chunk(cache, block_table, position, q, k, v, out_dtype):
     def append_q(codes, scales, blk, off, vals):
         # _paged_append_quantized on the gathered layout, arithmetic bit for bit
         # (codes live as exact integers in f32, so round/clip/rescale match)
-        old_q = codes[b_idx, :, blk]
-        old_scale = scales[b_idx, :, blk]
+        old_q = codes[b_idx, blk]  # (batch, heads, bs, hd)
+        old_scale = scales[b_idx, blk]
         vals32 = vals.astype(jnp.float32)[:, :, None, :]
         tok_scale = jnp.max(jnp.abs(vals32), axis=-1, keepdims=True) / 127.0
         fresh = (off == 0)[:, None, None, None]
@@ -206,30 +220,31 @@ def _paged_verify_chunk(cache, block_table, position, q, k, v, out_dtype):
         off_b = off[:, None, None, None]
         new_q = jnp.where(slot_idx < off_b, rescaled, jnp.where(slot_idx == off_b, tok_q, 0.0))
         new_q = jnp.clip(new_q, -127, 127)
-        return codes.at[b_idx, :, blk].set(new_q), scales.at[b_idx, :, blk].set(new_scale)
+        return codes.at[b_idx, blk].set(new_q), scales.at[b_idx, blk].set(new_scale)
 
     def step(state, j):
         pos = jnp.clip(pos0 + j, 0, capacity - 1)
         blk, off = pos // block_size, pos % block_size
         kj = jax.lax.dynamic_index_in_dim(k, j, axis=2, keepdims=False)
         vj = jax.lax.dynamic_index_in_dim(v, j, axis=2, keepdims=False)
+        qj = jax.lax.dynamic_index_in_dim(q, j, axis=2)  # (batch, heads, 1, hd)
         if quantized:
             kc, ks, vc, vs = state
             kc, ks = append_q(kc, ks, blk, off, kj)
             vc, vs = append_q(vc, vs, blk, off, vj)
             state = (kc, ks, vc, vs)
-            k_full = (kc * ks).reshape(batch, heads, capacity, head_dim).astype(out_dtype)
-            v_full = (vc * vs).reshape(batch, heads, capacity, head_dim).astype(out_dtype)
+            ctx = paged_attention(
+                qj, flat(kc), flat(vc), local_table, pos,
+                k_scale=flat(ks), v_scale=flat(vs), out_dtype=out_dtype, impl=impl,
+            )
         else:
             kb, vb = state
-            kb = kb.at[b_idx, :, blk, off].set(kj.astype(kb.dtype))
-            vb = vb.at[b_idx, :, blk, off].set(vj.astype(vb.dtype))
+            kb = kb.at[b_idx, blk, :, off].set(kj.astype(kb.dtype))
+            vb = vb.at[b_idx, blk, :, off].set(vj.astype(vb.dtype))
             state = (kb, vb)
-            k_full = kb.reshape(batch, heads, capacity, head_dim)
-            v_full = vb.reshape(batch, heads, capacity, head_dim)
-        qj = jax.lax.dynamic_index_in_dim(q, j, axis=2)  # (batch, heads, 1, hd)
-        mask = (k_pos[None, None, :] <= pos[:, None, None])[:, None, :, :]
-        ctx = xla_attention(qj, k_full, v_full, mask=mask)
+            ctx = paged_attention(
+                qj, flat(kb), flat(vb), local_table, pos, out_dtype=out_dtype, impl=impl,
+            )
         return state, ctx[:, :, 0, :]
 
     _, rows = jax.lax.scan(step, state, jnp.arange(S, dtype=jnp.int32))
@@ -378,7 +393,8 @@ class DecoderBlock(nn.Module):
                 # accepted tokens afterwards (paged_commit_chunk) from the fresh
                 # K/V stashed alongside the untouched pool leaves
                 context = _paged_verify_chunk(
-                    cache, block_table, position, q, k, v, cfg.dtype
+                    cache, block_table, position, q, k, v, cfg.dtype,
+                    impl=cfg.paged_attn_impl,
                 )
                 new_cache = {**cache, "ck": k, "cv": v}
             else:
@@ -428,30 +444,21 @@ class DecoderBlock(nn.Module):
                             jnp.moveaxis(v[0], 1, 0).astype(cache["v"].dtype)
                         )
 
-                def gather_table(pool_leaf, scale_leaf=None):
-                    # (batch, width, heads, bs, hd) -> (batch, heads, width*bs, hd):
-                    # logical position p lands at flattened column blk*bs+off == p,
-                    # so downstream masking is position arithmetic, same as dense
-                    blocks = pool_leaf[block_table]
-                    if scale_leaf is not None:
-                        # dequantize inside the gather: int8 is what crossed HBM, the
-                        # per-block-per-head scale rides the same table gather (shard-
-                        # local under the head-sharded pool spec), and empty blocks
-                        # (scale 0) decode to exact zeros the mask already discards
-                        blocks = (blocks.astype(jnp.float32) * scale_leaf[block_table]).astype(cfg.dtype)
-                    return jnp.moveaxis(blocks, 2, 1).reshape(
-                        batch, cfg.num_heads, capacity, cfg.head_dim
-                    )
-
-                k_pos = jnp.arange(capacity)
+                # attend through the table: impl="xla" is the historical
+                # gather-dequant-attend (bitwise-preserved in
+                # ops.paged_attention.xla_paged_attention); "pallas"/"auto"-on-TPU
+                # runs the fused kernel that reads int8 codes + scales straight
+                # off the pool — no dense dequantized gather copy in HBM. The
+                # positional mask is base-position arithmetic either way:
+                # query token s of row b sits at base[b] + s.
                 if per_row:
-                    q_pos = position[:, None] + jnp.arange(seq)[None, :]  # (batch, seq)
-                    mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, :, :]
+                    base = position.astype(jnp.int32)
                 else:
-                    q_pos = position + jnp.arange(seq)
-                    mask = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
-                context = xla_attention(
-                    q, gather_table(k_cache, k_scale), gather_table(v_cache, v_scale), mask=mask
+                    base = jnp.reshape(jnp.asarray(position, jnp.int32), (1,))
+                context = paged_attention(
+                    q, k_cache, v_cache, block_table, base,
+                    k_scale=k_scale, v_scale=v_scale,
+                    out_dtype=cfg.dtype, impl=cfg.paged_attn_impl,
                 )
                 new_cache = {"k": k_cache, "v": v_cache}
                 if quantized:

@@ -124,6 +124,11 @@ class MoEMlp(nn.Module):
 
         if self.dispatch not in ("gshard", "a2a"):
             raise ValueError(f"dispatch must be 'gshard' or 'a2a', got {self.dispatch!r}")
+        # k=1 must NOT renormalize: top_gate/top_gate == 1.0 would erase the
+        # Switch-style straight-through scaling (output scaled by the top-1 gate
+        # value) — and with it the router's only gradient path through the task
+        # loss. Same contract as ep.moe_apply_capacity, the top-1 wrapper.
+        normalize = self.k > 1
         if self.dispatch == "a2a" and not dropless:
             if self.mesh is None or "expert" not in self.mesh.shape:
                 raise ValueError("dispatch='a2a' requires a mesh with an 'expert' axis")
@@ -135,6 +140,7 @@ class MoEMlp(nn.Module):
                 self.mesh,
                 k=self.k,
                 capacity_factor=self.capacity_factor,
+                normalize_gates=normalize,
                 data_axis=self.data_axis,
             )
         else:
@@ -146,6 +152,7 @@ class MoEMlp(nn.Module):
                 self.mesh,
                 k=self.k,
                 capacity_factor=None if dropless else self.capacity_factor,
+                normalize_gates=normalize,
             )
         return out.reshape(x.shape).astype(x.dtype)
 

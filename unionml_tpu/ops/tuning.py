@@ -76,6 +76,42 @@ PACKED_TUNED_BLOCKS: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
 #: candidate block edges for the sweep and the fallback ladder
 BLOCK_CANDIDATES: Tuple[int, ...] = (512, 256, 128, 64)
 
+#: measured pallas-vs-XLA verdicts for the PAGED decode kernel
+#: (:mod:`unionml_tpu.ops.paged_attention`). Shape class:
+#: ``(table_width, block_size, heads, head_dim)`` — the four axes that fix the
+#: kernel's grid and per-step DMA. Populated from ``bench_kernels.py --paged``
+#: sweeps via the ``TUNING_MEASURED.json`` overlay (``tools/tpu_window.sh``
+#: ``paged_attn`` phase).
+MEASURED_PAGED_IMPL: Dict[Tuple[int, int, int, int], str] = {}
+
+#: unmeasured paged shapes default to the KERNEL — deliberately the opposite of
+#: the conservative dense default: the XLA arm's dense dequantized gather copy
+#: is a modeled ~4x HBM write+read the kernel structurally never issues
+#: (``paged_attention.gather_hbm_bytes`` vs ``fused_hbm_bytes``), so here the
+#: burden of proof sits on XLA; a measured window demotes per shape class.
+DEFAULT_PAGED_IMPL = "pallas"
+
+
+def pick_paged_impl(table_width: int, block_size: int, heads: int, head_dim: int) -> str:
+    """Measured paged-decode backend for a shape class ("pallas" or "xla")."""
+    return MEASURED_PAGED_IMPL.get(
+        (table_width, block_size, heads, head_dim), DEFAULT_PAGED_IMPL
+    )
+
+
+#: measured winners for the paged kernel's one tiling knob: heads folded into a
+#: single grid step (amortizes grid/DMA overhead when blocks are small). 1 is
+#: the proven-lowering default (plain 2D MXU dots); sweeps promote larger.
+PAGED_TUNED_HEADS: Dict[Tuple[int, int, int, int], int] = {}
+
+
+def pick_paged_heads(table_width: int, block_size: int, heads: int, head_dim: int) -> int:
+    """Heads per grid step for a paged shape class (measured winner, else 1)."""
+    tuned = PAGED_TUNED_HEADS.get((table_width, block_size, heads, head_dim))
+    if tuned and heads % tuned == 0:
+        return tuned
+    return 1
+
 
 def _largest_dividing(seq: int, cap: int = 128) -> int:
     for candidate in BLOCK_CANDIDATES:
@@ -144,7 +180,7 @@ def _apply_measured_overlay() -> None:
     if overlay is None:
         return
 
-    def parse(table):
+    def parse(table, rank=3):
         out = {}
         if not isinstance(table, dict):
             return out
@@ -153,7 +189,7 @@ def _apply_measured_overlay() -> None:
                 shape = tuple(int(x) for x in key.split(","))
             except (AttributeError, ValueError):
                 continue
-            if len(shape) == 3:
+            if len(shape) == rank:
                 out[shape] = value
         return out
 
@@ -181,6 +217,13 @@ def _apply_measured_overlay() -> None:
     for shape, blocks in parse(overlay.get("packed_tuned_blocks")).items():
         if valid_blocks(blocks):
             PACKED_TUNED_BLOCKS[shape] = tuple(blocks)
+    # paged-decode kernel tables: 4-axis keys "table_width,block_size,heads,head_dim"
+    for shape, impl in parse(overlay.get("measured_paged_impl"), rank=4).items():
+        if valid_impl(impl):
+            MEASURED_PAGED_IMPL[shape] = impl
+    for shape, gh in parse(overlay.get("paged_tuned_heads"), rank=4).items():
+        if isinstance(gh, int) and not isinstance(gh, bool) and gh > 0:
+            PAGED_TUNED_HEADS[shape] = gh
 
 
 _apply_measured_overlay()

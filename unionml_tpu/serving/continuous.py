@@ -496,6 +496,22 @@ class DecodeEngine:
             self._allocator = PrefixCache(
                 self.pool_blocks - 1, bs, telemetry=self._telemetry
             )
+            # resolve the decode-attention backend ONCE (same shape key the
+            # model's dispatcher sees at trace time: full table width + pool
+            # block size) so telemetry reports what the traced program runs
+            from unionml_tpu.ops.paged_attention import resolve_paged_impl
+
+            self.paged_attn_impl: Optional[str] = resolve_paged_impl(
+                getattr(config, "paged_attn_impl", "auto"),
+                self._table_width,
+                bs,
+                config.num_heads,
+                config.head_dim,
+            )
+            if self._telemetry is not None:
+                self._telemetry.paged_attn_impl.set(1.0, self.paged_attn_impl)
+        else:
+            self.paged_attn_impl = None
 
         self._init_device_state()
         self._sync_sampling_mirrors()
@@ -966,6 +982,19 @@ class DecodeEngine:
                 self._allocator = PrefixCache(
                     pool_blocks - 1, block_size, telemetry=self._telemetry
                 )
+                # the shape-class key changed with the re-layout: re-resolve
+                # the decode backend the retraced program will dispatch to
+                from unionml_tpu.ops.paged_attention import resolve_paged_impl
+
+                self.paged_attn_impl = resolve_paged_impl(
+                    getattr(self._config, "paged_attn_impl", "auto"),
+                    width,
+                    block_size,
+                    self._config.num_heads,
+                    self._config.head_dim,
+                )
+                if self._telemetry is not None:
+                    self._telemetry.paged_attn_impl.set(1.0, self.paged_attn_impl)
                 self._init_device_state()
                 self._sync_sampling_mirrors()
             self.prefix_cache = self._allocator
@@ -1172,6 +1201,8 @@ class DecodeEngine:
         if kv:  # {} on dense engines / before the pool exists
             self._telemetry.pool_kv_bytes.set(float(kv["kv_pool_bytes"]), kv["kv_dtype"])
             self._telemetry.pool_kv_bytes_dense_equiv.set(float(kv["kv_pool_bytes_dense_equiv"]))
+            if kv.get("impl"):
+                self._telemetry.paged_attn_impl.set(1.0, kv["impl"])
 
     def kv_pool_stats(self) -> Dict[str, Any]:
         """Byte accounting of the resident KV pool layout (shapes only — no
@@ -1189,6 +1220,9 @@ class DecodeEngine:
             "kv_dtype": self.kv_quantize or str(jnp.dtype(self._config.dtype).name),
             "kv_pool_bytes": stored,
             "kv_pool_bytes_dense_equiv": full,
+            # which decode-attention backend this replica's traced programs
+            # run ("pallas" = fused paged kernel, "xla" = gather + attend)
+            "impl": self.paged_attn_impl,
         }
 
     def _write_slot_row(self, slot: int, block_ids: Sequence[int]) -> None:

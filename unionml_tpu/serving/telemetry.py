@@ -282,6 +282,15 @@ class Telemetry:
             "unionml_kv_pool_bytes_dense_equiv",
             "Same KV pool positions priced at the full compute dtype",
         )
+        # info gauge (value pinned to 1): the impl label names the decode
+        # attention backend the replica's traced programs dispatch to —
+        # "pallas" (fused paged kernel, ISSUE 18) or "xla" (gather + attend).
+        # Fleet operators fan this out to see which replicas run fused.
+        self.paged_attn_impl = m.gauge(
+            "unionml_paged_attn_impl",
+            "Selected paged decode-attention backend (info gauge, value=1)",
+            ("impl",),
+        )
         self.blocks_per_request = m.histogram(
             "unionml_kv_blocks_per_request",
             "Pool blocks allocated per admitted request (paged engines)",
