@@ -284,11 +284,12 @@ def test_inline_suppression_applies_to_its_own_line(tmp_path):
 def test_json_report_schema(tmp_path):
     result = _lint_source(tmp_path, "schema", HOST_SYNC_REPRO)
     report = json.loads(result.report_json())
-    assert report["graftlint"] == REPORT_VERSION == 2
+    assert report["graftlint"] == REPORT_VERSION == 3
     assert set(report) == {
         "graftlint", "paths", "rules", "files", "counts",
-        "findings", "suppressed", "baselined",
+        "findings", "suppressed", "baselined", "timings",
     }
+    assert report["timings"]["parse"] >= 0.0  # per-family wall, seconds
     assert report["files"] == 1
     assert report["counts"] == {
         "findings": len(result.findings),
@@ -1083,6 +1084,369 @@ def test_mutated_serving_release_path_is_caught(label, old, new, symbol, witness
         result = _run(
             [str(f)], ["resource-leak", "double-release", "unbalanced-transfer"]
         )
+    assert len(result.findings) == 1, [x.format() for x in result.findings]
+    (finding,) = result.findings
+    assert finding.symbol == symbol
+    assert witness in finding.message
+
+
+# ======================================================== graftlint v4: races
+# (threads + rules_races: thread-role inference feeding a lock-set data-race
+# detector plus the check-then-act / lock-leaf / fires-outside-lock contracts)
+
+RACES_REPRO = '''
+import threading
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0  # guarded-by: _lock
+        self.peak = 0
+        self._thread = threading.Thread(target=self._worker, name="drainer")
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self.depth -= 1
+            if self.peak > 0:
+                self.peak -= 1
+
+    def submit(self, item):
+        with self._lock:
+            self.depth += 1
+        if self.depth > 8:
+            raise RuntimeError(item)
+        self.peak = max(self.peak, self.depth)
+
+    def collapse(self):
+        with self._lock:
+            if self.depth == 0:
+                drained = True
+            else:
+                drained = False
+        if drained:
+            with self._lock:
+                self.depth = -1
+        return drained
+'''
+
+LOCK_LEAF_REPRO = '''
+import threading
+import time
+
+class Telemetry:
+    def __init__(self):
+        self._stats_lock = threading.Lock()  # lock-leaf
+        self._journal_lock = threading.Lock()
+        self.counters = {}
+
+    def bump(self, key):
+        with self._stats_lock:
+            with self._journal_lock:
+                self.counters[key] = 1
+
+    def flush(self):
+        with self._stats_lock:
+            time.sleep(0.1)
+
+    def drain(self):
+        with self._stats_lock:
+            self._persist()
+
+    def _persist(self):
+        with self._journal_lock:
+            pass
+'''
+
+CALLBACK_REPRO = '''
+import threading
+
+class Supervisor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subscribers = []
+        self._state = "idle"
+
+    def subscribe(self, callback):  # fires-outside-lock
+        self._subscribers.append(callback)
+
+    def transition(self, state):
+        with self._lock:
+            old, self._state = self._state, state
+            for cb in list(self._subscribers):
+                cb(old, state)
+'''
+
+RACES_CLEAN = '''
+import threading
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-leaf
+        self.depth = 0  # guarded-by: _lock
+        self._subscribers = []
+        self._thread = threading.Thread(target=self._worker, name="drainer")
+        self._thread.start()
+
+    def subscribe(self, callback):  # fires-outside-lock
+        self._subscribers.append(callback)
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self.depth -= 1
+
+    def submit(self, item):
+        with self._lock:
+            self.depth += 1
+            deep = self.depth > 8
+        if deep:
+            raise RuntimeError(item)
+
+    def collapse(self):
+        with self._lock:
+            if self.depth == 0:
+                self.depth = -1
+                return True
+        return False
+
+    def _notify(self, state):
+        for cb in list(self._subscribers):
+            cb(state)
+'''
+
+
+def test_data_race_repro_fires_with_thread_role_witnesses(tmp_path):
+    result = _lint_source(tmp_path, "races", RACES_REPRO)
+    assert {f.rule for f in result.findings} == {"data-race", "check-then-act"}
+    by_symbol = {f.symbol: f for f in result.findings}
+    # lock-set violation: no lock EVER guards self.peak, flagged once at the
+    # first write with both thread roles named
+    peak = by_symbol["Pipeline._worker"]
+    assert "self.peak" in peak.message
+    assert "thread:drainer" in peak.message and "api" in peak.message
+    assert "NO lock is ever held" in peak.message
+    # guarded-by contract: the declared lock is simply missing at this read
+    guarded = by_symbol["Pipeline.submit"]
+    assert "guarded-by: _lock" in guarded.message and "without" in guarded.message
+    # check-then-act: condition checked under one hold region, acted on under
+    # a separate one — the finding cites the stale read's line
+    cta = by_symbol["Pipeline.collapse"]
+    assert cta.rule == "check-then-act" and "line 28" in cta.message
+
+
+def test_lock_leaf_repro_fires_all_three_shapes(tmp_path):
+    result = _lint_source(tmp_path, "leaf", LOCK_LEAF_REPRO, rules=["lock-leaf"])
+    by_symbol = {f.symbol: f.message for f in result.findings}
+    assert "a leaf lock must stay the innermost lock" in by_symbol["Telemetry.bump"]
+    assert "time.sleep() sleeps the thread" in by_symbol["Telemetry.flush"]
+    # interprocedural: the acquisition hides one call away
+    assert "Telemetry._persist()" in by_symbol["Telemetry.drain"]
+
+
+def test_callback_under_lock_repro_fires(tmp_path):
+    result = _lint_source(tmp_path, "cb", CALLBACK_REPRO)
+    (finding,) = result.findings
+    assert finding.rule == "callback-under-lock"
+    assert finding.symbol == "Supervisor.transition"
+    assert "Supervisor.subscribe" in finding.message
+    assert "fires-outside-lock" in finding.message
+
+
+def test_races_clean_twin_is_finding_free(tmp_path):
+    """Each repro's fixed form: the check moved under the SAME hold region,
+    honest leaf locks, and callbacks fired after the lock is dropped — plus
+    the contract annotations themselves lint clean."""
+    result = _lint_source(tmp_path, "races_ok", RACES_CLEAN)
+    assert result.ok, [f.format() for f in result.findings]
+
+
+def test_races_golden_report(tmp_path):
+    """Machine-readable pin for the races family (full catalog run: the
+    blocking-leaf repro legitimately trips lock-order too — the families
+    overlap by design, each naming its own contract)."""
+    expected = [
+        {"rule": "data-race", "line": 17, "col": 16, "symbol": "Pipeline._worker"},
+        {"rule": "data-race", "line": 22, "col": 11, "symbol": "Pipeline.submit"},
+        {"rule": "check-then-act", "line": 34, "col": 16, "symbol": "Pipeline.collapse"},
+    ]
+    report = _lint_source(tmp_path, "races", RACES_REPRO).report()
+    got = [
+        {k: entry[k] for k in ("rule", "line", "col", "symbol")}
+        for entry in report["findings"]
+    ]
+    assert got == expected, json.dumps(got, indent=2)
+    leaf_expected = [
+        {"rule": "lock-leaf", "line": 13, "symbol": "Telemetry.bump"},
+        {"rule": "lock-leaf", "line": 18, "symbol": "Telemetry.flush"},
+        {"rule": "lock-order", "line": 18, "symbol": "Telemetry.flush"},
+        {"rule": "lock-leaf", "line": 22, "symbol": "Telemetry.drain"},
+    ]
+    leaf_report = _lint_source(tmp_path, "leaf", LOCK_LEAF_REPRO).report()
+    leaf_got = [
+        {k: entry[k] for k in ("rule", "line", "symbol")}
+        for entry in leaf_report["findings"]
+    ]
+    assert leaf_got == leaf_expected, json.dumps(leaf_got, indent=2)
+
+
+def test_races_rules_are_registered_and_listable(capsys):
+    from unionml_tpu.analysis.core import RULES, families
+
+    catalog = families()
+    assert set(catalog["races"]) == {
+        "data-race", "check-then-act", "lock-leaf", "callback-under-lock",
+    }
+    for name in catalog["races"]:
+        assert RULES[name].family == "races"
+    assert lint_main(["--list-rules"]) == 0
+    listing = capsys.readouterr().out
+    for name in ("data-race", "check-then-act", "lock-leaf", "callback-under-lock"):
+        assert name in listing
+
+
+def test_races_sarif_validates_and_catalogs_the_family(tmp_path):
+    import pathlib
+
+    jsonschema = pytest.importorskip("jsonschema")
+    schema = json.loads(
+        (pathlib.Path(__file__).parent / "sarif_2_1_0_schema.json").read_text()
+    )
+    doc = _lint_source(tmp_path, "races", RACES_REPRO).sarif()
+    jsonschema.validate(doc, schema)
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"data-race", "check-then-act", "lock-leaf", "callback-under-lock"} <= rules
+    hit = {r["ruleId"] for r in doc["runs"][0]["results"]}
+    assert hit == {"data-race", "check-then-act"}
+
+
+# ------------------------------------------------- the v4 CLI: --only / --paths
+
+
+def test_cli_only_family_selects_whole_families(tmp_path, capsys):
+    bad = tmp_path / "leafbad.py"
+    bad.write_text(LOCK_LEAF_REPRO)
+    assert lint_main([str(bad), "--only", "races"]) == 1
+    # out-of-family rules don't run: sharding has nothing to say here
+    assert lint_main([str(bad), "--only", "sharding"]) == 0
+    # unknown family names the catalog and exits 2 (bad invocation, not dirty)
+    assert lint_main([str(bad), "--only", "nosuch"]) == 2
+    err = capsys.readouterr().err
+    assert "unknown family" in err and "races" in err
+    # --rules and --only cannot be combined
+    assert lint_main([str(bad), "--rules", "data-race", "--only", "races"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_paths_restricts_reporting_not_the_scan(tmp_path, capsys):
+    bad = tmp_path / "cbbad.py"
+    bad.write_text(CALLBACK_REPRO)
+    ok = tmp_path / "fine.py"
+    ok.write_text(CLEAN)
+    # the full scan fails; restricted to the clean file the same scan exits 0
+    assert lint_main([str(tmp_path)]) == 1
+    assert lint_main([str(tmp_path), "--paths", str(ok)]) == 0
+    assert lint_main([str(tmp_path), "--paths", str(bad)]) == 1
+    capsys.readouterr()
+
+
+def test_cli_timings_prints_per_family_wall_time(tmp_path, capsys):
+    ok = tmp_path / "ok.py"
+    ok.write_text(CLEAN)
+    assert lint_main([str(ok), "--timings"]) == 0
+    out = capsys.readouterr().out
+    assert "parse" in out and "races" in out
+
+
+# ----------------------------- tree-grounded mutations: the races family
+# detects a real deleted guard (the PR-landing acceptance for v4)
+
+
+@pytest.mark.parametrize(
+    "label, filename, companions, old, new, rules, symbol, witness",
+    [
+        (
+            "requeue-guard-in-adopt_ticket",
+            "continuous.py",
+            (),
+            '        with self._lock:\n'
+            '            if self._closed:\n'
+            '                raise EngineFailure("batcher is closed", reason="batcher_closed")\n'
+            '            self.scheduler.requeue(ticket, preemption=False)\n',
+            '        if True:\n'
+            '            if self._closed:\n'
+            '                raise EngineFailure("batcher is closed", reason="batcher_closed")\n'
+            '            self.scheduler.requeue(ticket, preemption=False)\n',
+            ["data-race"],
+            "ContinuousBatcher.adopt_ticket",
+            "thread:continuous-batcher",
+        ),
+        (
+            "session-map-guard-in-session_replica",
+            "fleet.py",
+            ("supervisor.py",),
+            '        with self._lock:\n'
+            '            entry = self._sessions.get(session_id)\n',
+            '        if True:\n'
+            '            entry = self._sessions.get(session_id)\n',
+            ["data-race"],
+            "Router.session_replica",
+            "thread:engine-watchdog",
+        ),
+        (
+            "notify-moved-under-lock-in-note_failure",
+            "supervisor.py",
+            (),
+            '            new = self._state\n        self._notify(old, new)\n',
+            '            new = self._state\n            self._notify(old, new)\n',
+            ["callback-under-lock"],
+            "EngineSupervisor.note_failure",
+            "fires-outside-lock",
+        ),
+        (
+            "sleep-injected-into-leaf-hold-region",
+            "telemetry.py",
+            (),
+            '        with self._lock:\n'
+            '            trace = self._active.pop(request_id, None)\n',
+            '        with self._lock:\n'
+            '            time.sleep(0.001)\n'
+            '            trace = self._active.pop(request_id, None)\n',
+            ["lock-leaf"],
+            "Telemetry.end_trace",
+            "lock-leaf",
+        ),
+    ],
+)
+def test_mutated_serving_guard_is_caught(label, filename, companions, old, new,
+                                         rules, symbol, witness):
+    """Tree-grounded regressions for v4: break ONE concurrency guard in the
+    REAL serving source and the races family must produce EXACTLY ONE finding
+    naming the broken function, with its thread-role witness — the fleet's
+    locking discipline is mechanically enforced, not reviewer folklore.
+    (fleet.py lints together with supervisor.py: the watchdog thread role
+    reaches the Router through the supervisor's subscriber registry.)"""
+    import pathlib
+    import shutil
+    import tempfile
+
+    from unionml_tpu.analysis import run_lint as _run
+
+    serving = (
+        pathlib.Path(__file__).resolve().parent.parent.parent
+        / "unionml_tpu" / "serving"
+    )
+    src = (serving / filename).read_text()
+    mutated = src.replace(old, new, 1)
+    assert mutated != src, f"{label}: the guard moved; update this mutation"
+    with tempfile.TemporaryDirectory() as d:
+        scope = [pathlib.Path(d) / filename]
+        scope[0].write_text(mutated)
+        for companion in companions:
+            scope.append(pathlib.Path(d) / companion)
+            shutil.copy(serving / companion, scope[-1])
+        result = _run([str(p) for p in scope], rules)
     assert len(result.findings) == 1, [x.format() for x in result.findings]
     (finding,) = result.findings
     assert finding.symbol == symbol

@@ -266,3 +266,48 @@ def test_resident_mesh_sharded_predictions_identical():
     # the committed artifact lives on every mesh device
     leaves = jax.tree_util.tree_leaves(sharded._device_model_object)
     assert len(leaves[0].sharding.device_set) == 4
+
+
+def test_resident_setup_races_compile_exactly_once(monkeypatch):
+    """Runtime twin of the graftlint v4 data-race finding on the lazy setup:
+    several first requests race through predict()'s readiness fast path at
+    once. The ``_setup_lock`` double-check must let EXACTLY ONE caller compile
+    and commit the artifact to device; the rest block until it is ready and
+    then serve off the same executable."""
+    import threading
+
+    model = _build_tokenized_model()
+    resident = ResidentPredictor(model, buckets=(4,), warmup=False)
+
+    compiles: List[int] = []
+    real_jit = jax.jit
+
+    def counting_jit(fn, *a, **k):
+        compiles.append(threading.get_ident())
+        return real_jit(fn, *a, **k)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+
+    n = 8
+    barrier = threading.Barrier(n)
+    results: List[np.ndarray] = []
+    errors: List[BaseException] = []
+
+    def first_request():
+        try:
+            barrier.wait()
+            results.append(np.asarray(resident.predict(features=[{"len": 3}])))
+        except BaseException as exc:  # pragma: no cover - failure detail
+            errors.append(exc)
+
+    threads = [threading.Thread(target=first_request) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert resident._ready and resident._compiled is not None
+    assert len(compiles) == 1, f"setup body ran {len(compiles)} times"
+    assert len(results) == n
+    for out in results:
+        np.testing.assert_allclose(out, [1.0], atol=1e-6)

@@ -48,6 +48,8 @@ MODULES = [
     ("unionml_tpu.debug", "Debugging"),
     ("unionml_tpu.profiling", "Profiling"),
     ("unionml_tpu.analysis", "Static analysis (graftlint)"),
+    ("unionml_tpu.analysis.threads", "Thread-role inference (graftlint v4)"),
+    ("unionml_tpu.analysis.rules_races", "Data-race & lock-contract rules (graftlint v4)"),
 ]
 
 

@@ -31,18 +31,21 @@ fi
 
 # graftlint gate (CPU-only, no tunnel needed): refuse to spend a TPU window
 # measuring a tree with hot-path host-sync / retrace / sharding / lock /
-# use-after-donate / lock-order / async-blocking findings or leaked
+# use-after-donate / lock-order / async-blocking findings, leaked
 # resources (resource-leak / double-release / unbalanced-transfer — a pin
-# leak skews every pool-pressure number) — the findings invalidate the
+# leak skews every pool-pressure number), or v4 concurrency findings
+# (data-race / check-then-act / lock-leaf / callback-under-lock — a racing
+# fleet produces numbers that don't reproduce) — the findings invalidate the
 # serving numbers before they are taken. Widened scope (the
 # bench scripts themselves are linted; tests ride the recorded baseline), a
-# SARIF artifact for the caller to commit/upload, and the 10s runtime budget
-# so a slow linter can never eat the tunnel window it exists to protect.
+# SARIF artifact for the caller to commit/upload, the 10s runtime budget
+# so a slow linter can never eat the tunnel window it exists to protect, and
+# --timings so a budget blow names the family that regressed.
 if ! timeout 120 env JAX_PLATFORMS=cpu python -m unionml_tpu.analysis \
     unionml_tpu tools tests bench.py bench_int8.py bench_kernels.py \
     bench_mfu.py bench_packing.py bench_serving.py bench_sim.py bench_util.py \
     --baseline tools/graftlint_baseline.json \
-    --sarif /tmp/tpu_lint.sarif --budget 10 --fail-on-findings \
+    --sarif /tmp/tpu_lint.sarif --budget 10 --timings --fail-on-findings \
     > /tmp/tpu_lint.out 2>&1; then
   echo "$STAMP tpu_window.sh: graftlint findings; aborting battery (see /tmp/tpu_lint.out, /tmp/tpu_lint.sarif)" >> TPU_PROBES.log
   exit 4

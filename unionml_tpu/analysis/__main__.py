@@ -5,6 +5,13 @@ Findings always fail the run — ``--fail-on-findings`` exists so CI scripts
 state the contract explicitly; ``--no-fail-on-findings`` turns the run
 advisory (report only).
 
+Scoped runs: ``--only FAMILY[,FAMILY...]`` selects whole rule families
+(``races``, ``locks``, ``sharding``, ...; see ``--list-rules``) instead of
+naming individual rules; ``--paths FILE [FILE...]`` is the incremental /
+pre-commit mode — the full scan still runs (interprocedural passes need the
+whole call graph) but only findings located in the named files are reported.
+``--timings`` prints per-family wall time after the summary.
+
 CI surfaces: ``--sarif OUT`` writes a SARIF 2.1.0 report (GitHub
 code-scanning upload → findings annotate PRs inline); ``--baseline FILE``
 silences findings recorded in FILE (new ones still fail) so a widened lint
@@ -22,6 +29,7 @@ import time
 from unionml_tpu.analysis.core import (
     RULES,
     baseline_payload,
+    families,
     load_baseline,
     run_lint,
 )
@@ -37,6 +45,14 @@ def main(argv=None) -> int:
     parser.add_argument("paths", nargs="*", default=["unionml_tpu"],
                         help="files or directories to lint (default: unionml_tpu)")
     parser.add_argument("--rules", help="comma-separated rule subset (default: all)")
+    parser.add_argument("--only", metavar="FAMILY", dest="only",
+                        help="comma-separated rule FAMILY subset (e.g. races,locks); "
+                             "see --list-rules for the catalog")
+    parser.add_argument("--paths", metavar="FILE", dest="report_paths", nargs="+",
+                        help="incremental mode: scan the full tree for context but "
+                             "report only findings located in these files")
+    parser.add_argument("--timings", action="store_true",
+                        help="print per-family wall time after the summary")
     parser.add_argument("--json", metavar="OUT", dest="json_out",
                         help="write the machine-readable report to OUT ('-' for stdout)")
     parser.add_argument("--sarif", metavar="OUT", dest="sarif_out",
@@ -59,11 +75,26 @@ def main(argv=None) -> int:
 
         _load_rule_modules()
         for name in sorted(RULES):
-            print(f"{name:16s} {RULES[name].summary}")
+            print(f"{name:16s} [{RULES[name].family}] {RULES[name].summary}")
         print("suppression      (always on) graftlint comments need a known rule and a reason")
         return 0
 
+    if args.rules and args.only:
+        print("graftlint: --rules and --only are mutually exclusive", file=sys.stderr)
+        return 2
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    if args.only:
+        catalog = families()
+        wanted = [f.strip() for f in args.only.split(",") if f.strip()]
+        unknown = [f for f in wanted if f not in catalog]
+        if unknown:
+            print(
+                f"graftlint: unknown family(ies): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(catalog))})",
+                file=sys.stderr,
+            )
+            return 2
+        rules = sorted({name for f in wanted for name in catalog[f]})
     baseline = None
     if args.baseline:
         try:
@@ -73,7 +104,10 @@ def main(argv=None) -> int:
             return 2
     t0 = time.perf_counter()
     try:
-        result = run_lint(args.paths or ["unionml_tpu"], rules, baseline=baseline)
+        result = run_lint(
+            args.paths or ["unionml_tpu"], rules,
+            baseline=baseline, restrict=args.report_paths,
+        )
     except ValueError as exc:
         print(f"graftlint: {exc}", file=sys.stderr)
         return 2
@@ -101,6 +135,9 @@ def main(argv=None) -> int:
         + (f" (budget {args.budget:.0f}s)" if args.budget else "")
     )
     print(summary, file=sys.stderr if result.findings else sys.stdout)
+    if args.timings:
+        for fam, fam_s in sorted(result.timings.items(), key=lambda kv: -kv[1]):
+            print(f"graftlint:   {fam:12s} {fam_s:6.2f}s")
 
     if args.json_out:
         payload = result.report_json() + "\n"

@@ -26,6 +26,13 @@ import time per file). Three source-comment conventions drive it:
   <resource>`` on a ``self.x = ...`` line in ``__init__`` declares an
   attribute that stores live resources, so overwriting it without a release
   is a leak.
+- ``# lock-leaf`` on a lock's ``__init__`` (or module-level) assignment
+  declares it a LEAF in the lock hierarchy: no other project lock may be
+  acquired and no blocking call made while it is held (rule ``lock-leaf``).
+- ``# fires-outside-lock`` on a callback-registration ``def`` (a method that
+  appends its callable parameter into instance state) declares that the
+  registered callbacks are always invoked OUTSIDE the class's locks; the
+  ``callback-under-lock`` rule verifies every firing site.
 
 Suppressions anchor to LOGICAL lines: a finding anywhere inside a multi-line
 statement (or on a decorated ``def``'s signature) is silenced by a suppression
@@ -38,13 +45,16 @@ import dataclasses
 import hashlib
 import json
 import re
+import time
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
 #: JSON report schema version (bump on any shape change; pinned by tests).
 #: v2: interprocedural rule families (use-after-donate / lock-order /
 #: async-blocking), the ``baselined`` findings list, and SARIF output.
-REPORT_VERSION = 2
+#: v3: the ``timings`` per-family wall-time map (budget regressions must be
+#: attributable to a family, not "environmental").
+REPORT_VERSION = 3
 
 #: a comment is a DIRECTIVE only when the linter's name is followed by a
 #: colon; prose comments that merely mention the linter by name are not parsed
@@ -65,6 +75,18 @@ _RESOURCE_LIST = r"([A-Za-z][A-Za-z0-9_\-]*(?:\s*,\s*[A-Za-z][A-Za-z0-9_\-]*)*)"
 _OWNS_RE = re.compile(r"#\s*owns:\s*" + _RESOURCE_LIST)
 _TRANSFERS_RE = re.compile(r"#\s*transfers:\s*" + _RESOURCE_LIST)
 _HOLDS_RE = re.compile(r"#\s*holds:\s*" + _RESOURCE_LIST)
+#: concurrency contracts (rules_races): ``lock-leaf`` on a lock assignment,
+#: ``fires-outside-lock`` on a callback-registration def
+_LOCK_LEAF_RE = re.compile(r"#\s*lock-leaf\b")
+_FIRES_OUTSIDE_RE = re.compile(r"#\s*fires-outside-lock\b")
+
+#: substrings that gate the tokenize-based comment pass: a file mentioning
+#: none of them carries no graftlint annotation, and re-tokenizing every
+#: source was a measurable slice of the lint budget
+_COMMENT_KEYWORDS = (
+    "graftlint", "guarded-by", "lock-order", "lock-leaf",
+    "fires-outside-lock", "owns:", "transfers:", "holds:",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -151,16 +173,25 @@ class SourceModule:
         self.owns: Dict[int, Tuple[str, ...]] = {}
         self.transfers: Dict[int, Tuple[str, ...]] = {}
         self.holds: Dict[int, Tuple[str, ...]] = {}
+        #: code lines of ``lock-leaf`` lock assignments and
+        #: ``fires-outside-lock`` registration defs (rules_races contracts)
+        self.lock_leaves: set = set()
+        self.fires_outside: set = set()
         #: malformed-comment findings emitted by the parse (rule ``suppression``)
         self.comment_findings: List[Finding] = []
         #: physical line -> first line of its logical statement (suppression
-        #: anchoring: a multi-line call or a decorated def is ONE logical line)
-        self._anchors: Dict[int, int] = {}
-        self._build_anchors()
-        self._code_lines = sorted(self._anchors)
+        #: anchoring: a multi-line call or a decorated def is ONE logical line).
+        #: Built lazily: only annotated files (and files a finding lands in)
+        #: ever consult it, and the full-tree walk it needs was a measurable
+        #: slice of the lint budget across ~200 unannotated modules.
+        self._anchors: Optional[Dict[int, int]] = None
+        self._code_lines: List[int] = []
         self._parse_comments()
 
     def _build_anchors(self) -> None:
+        if self._anchors is not None:
+            return
+        self._anchors = {}
         # ast.walk is breadth-first: parents before children, so inner
         # statements override the span their compound parent claimed — a line
         # anchors to its INNERMOST statement. A def's decorators and signature
@@ -176,9 +207,11 @@ class SourceModule:
             end = getattr(node, "end_lineno", None) or node.lineno
             for ln in range(start, end + 1):
                 self._anchors[ln] = start
+        self._code_lines = sorted(self._anchors)
 
     def logical_anchor(self, line: int) -> int:
         """First line of the logical statement containing ``line``."""
+        self._build_anchors()
         return self._anchors.get(line, line)
 
     def _next_code_line(self, line: int) -> int:
@@ -207,6 +240,9 @@ class SourceModule:
             return
 
     def _parse_comments(self) -> None:
+        if not any(k in self.text for k in _COMMENT_KEYWORDS):
+            return
+        self._build_anchors()
         for line, col, comment, standalone in self._iter_comments():
             # a standalone comment line governs the next code line
             target = self._next_code_line(line) if standalone else line
@@ -226,6 +262,10 @@ class SourceModule:
                 m = regex.search(comment)
                 if m:
                     table[target] = tuple(r.strip() for r in m.group(1).split(","))
+            if _LOCK_LEAF_RE.search(comment):
+                self.lock_leaves.add(target)
+            if _FIRES_OUTSIDE_RE.search(comment):
+                self.fires_outside.add(target)
 
     def _parse_graftlint_comment(self, line: int, col: int, comment: str, target: int) -> None:
         marker = _MARKER_RE.search(comment)
@@ -284,10 +324,14 @@ class SourceModule:
 class Rule:
     """A registered lint rule: ``check(project)`` yields raw findings."""
 
-    def __init__(self, name: str, summary: str, check) -> None:
+    def __init__(self, name: str, summary: str, check, family: str) -> None:
         self.name = name
         self.summary = summary
         self.check = check
+        #: rule family = registering module minus the ``rules_`` prefix
+        #: ("races", "resources", ...) — the unit of ``--only`` selection and
+        #: of per-family wall-time attribution
+        self.family = family
 
 
 #: rule registry: name -> Rule (populated by the rule modules at import)
@@ -295,13 +339,28 @@ RULES: Dict[str, Rule] = {}
 
 
 def register(name: str, summary: str):
-    """Decorator registering ``check(project)`` under ``name``."""
+    """Decorator registering ``check(project)`` under ``name``. The family is
+    derived from the registering module, so a new rule module lands in the
+    ``--only`` catalog, the SARIF catalog, and the timing report with no
+    registration beyond its import in :func:`_load_rule_modules`."""
 
     def wrap(check):
-        RULES[name] = Rule(name, summary, check)
+        family = check.__module__.rsplit(".", 1)[-1]
+        if family.startswith("rules_"):
+            family = family[len("rules_"):]
+        RULES[name] = Rule(name, summary, check, family)
         return check
 
     return wrap
+
+
+def families() -> Dict[str, List[str]]:
+    """family name -> sorted rule names (the ``--only FAMILY`` catalog)."""
+    _load_rule_modules()
+    out: Dict[str, List[str]] = {}
+    for name, rule in RULES.items():
+        out.setdefault(rule.family, []).append(name)
+    return {fam: sorted(names) for fam, names in out.items()}
 
 
 def _module_name(path: Path) -> str:
@@ -366,6 +425,7 @@ def _load_rule_modules() -> None:
         rules_exceptions,
         rules_host_sync,
         rules_locks,
+        rules_races,
         rules_resources,
         rules_retrace,
         rules_sharding,
@@ -417,6 +477,7 @@ def run_lint(
     rules: Optional[Sequence[str]] = None,
     *,
     baseline: Optional[Dict[str, Dict[str, object]]] = None,
+    restrict: Optional[Sequence[str]] = None,
 ) -> "LintResult":
     """Lint ``paths`` with the selected (default: all) rules.
 
@@ -424,6 +485,10 @@ def run_lint(
     is recorded into ``result.baselined`` — reported, but not failing — so a
     widened scope can land with its pre-existing findings inventoried and only
     NEW ones breaking the build.
+
+    ``restrict`` keeps the full ``paths`` scan (the interprocedural passes
+    need the whole call graph for context) but reports only findings located
+    in the named files — the ``--paths`` incremental / pre-commit mode.
     """
     # rule modules self-register on import (Project also does this, but rule
     # selection below needs the registry before any Project exists)
@@ -433,14 +498,18 @@ def run_lint(
     unknown = [r for r in selected if r not in RULES]
     if unknown:
         raise ValueError(f"unknown rule(s): {', '.join(unknown)} (known: {', '.join(sorted(RULES))})")
+    t0 = time.perf_counter()
     project = Project(paths)
+    timings: Dict[str, float] = {"parse": time.perf_counter() - t0}
     active: List[Finding] = list(project.errors)
     suppressed: List[Finding] = []
     for mod in project.modules:
         active.extend(mod.comment_findings)  # suppression hygiene is not optional
+    mods_by_path = {m.relpath: m for m in project.modules}
     for name in selected:
+        t0 = time.perf_counter()
         for finding in RULES[name].check(project):
-            mod = next((m for m in project.modules if m.relpath == finding.path), None)
+            mod = mods_by_path.get(finding.path)
             sup = mod.suppression_for(name, finding.line) if mod else None
             if sup is not None:
                 suppressed.append(
@@ -448,13 +517,20 @@ def run_lint(
                 )
             else:
                 active.append(finding)
+        fam = RULES[name].family
+        timings[fam] = timings.get(fam, 0.0) + time.perf_counter() - t0
+    if restrict is not None:
+        wanted = {Path(p).resolve() for p in restrict}
+        active = [f for f in active if Path(f.path).resolve() in wanted]
+        suppressed = [f for f in suppressed if Path(f.path).resolve() in wanted]
     active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     baselined: List[Finding] = []
     if baseline:
         active, baselined = _split_baselined(active, baseline)
     return LintResult(paths=list(paths), rules=selected, files=len(project.modules),
-                      findings=active, suppressed=suppressed, baselined=baselined)
+                      findings=active, suppressed=suppressed, baselined=baselined,
+                      timings=timings)
 
 
 @dataclasses.dataclass
@@ -469,6 +545,9 @@ class LintResult:
     #: pre-existing findings recorded in a ``--baseline`` file: reported, not
     #: failing (``ok`` ignores them) — the widened-scope landing mechanism
     baselined: List[Finding] = dataclasses.field(default_factory=list)
+    #: wall seconds per rule family, plus "parse" (project build): the budget
+    #: attribution surface — a regression names a family, not "environmental"
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -485,6 +564,7 @@ class LintResult:
                 "suppressed": len(self.suppressed),
                 "baselined": len(self.baselined),
             },
+            "timings": {fam: round(s, 3) for fam, s in sorted(self.timings.items())},
             "findings": [f.as_dict() for f in self.findings],
             "suppressed": [f.as_dict() for f in self.suppressed],
             "baselined": [f.as_dict() for f in self.baselined],

@@ -62,9 +62,19 @@ _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
 _SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output", "communicate"}
 
 
-def own_nodes(root: ast.AST) -> Iterator[ast.AST]:
+def own_nodes(root: ast.AST) -> List[ast.AST]:
     """Walk ``root`` without descending into nested function/class bodies or
-    lambdas — the nodes that execute as part of *this* function's frame."""
+    lambdas — the nodes that execute as part of *this* function's frame.
+
+    Function roots cache the materialized walk on the node: every rule family
+    sweeps every function at least once, and re-generating the same ~300k
+    nodes per family was a measurable slice of the lint budget."""
+    is_fn = isinstance(root, (ast.FunctionDef, ast.AsyncFunctionDef))
+    if is_fn:
+        cached = getattr(root, "_graftlint_own", None)
+        if cached is not None:
+            return cached
+    out: List[ast.AST] = []
     stack: List[ast.AST] = [root]
     first = True
     while stack:
@@ -74,8 +84,11 @@ def own_nodes(root: ast.AST) -> Iterator[ast.AST]:
         ):
             continue
         first = False
-        yield node
+        out.append(node)
         stack.extend(ast.iter_child_nodes(node))
+    if is_fn:
+        root._graftlint_own = out
+    return out
 
 
 def _call_map(fn: FunctionInfo) -> Dict[int, List[Tuple[str, str]]]:
@@ -85,6 +98,21 @@ def _call_map(fn: FunctionInfo) -> Dict[int, List[Tuple[str, str]]]:
     if cache is None:
         cache = {id(node): cands for cands, node in fn.calls}
         fn._graftlint_call_map = cache
+    return cache
+
+
+def resolved_edges(graph: CallGraph, fn: FunctionInfo) -> List[Tuple[FunctionInfo, ast.Call]]:
+    """``fn``'s call sites with a scanned callee, resolved ONCE and cached —
+    every interprocedural fixpoint iterates call edges repeatedly, and
+    re-running candidate resolution each sweep dominated the lint wall time."""
+    cache = getattr(fn, "_graftlint_edges", None)
+    if cache is None:
+        cache = []
+        for candidates, call in fn.calls:
+            callee = graph._resolve(candidates)
+            if callee is not None:
+                cache.append((callee, call))
+        fn._graftlint_edges = cache
     return cache
 
 
@@ -269,9 +297,8 @@ class Summaries:
             changed = False
             for idx in self.graph.indexes:
                 for fn in idx.functions.values():
-                    for candidates, call in fn.calls:
-                        callee = self.graph._resolve(candidates)
-                        if callee is None or callee.key == fn.key:
+                    for callee, call in resolved_edges(self.graph, fn):
+                        if callee.key == fn.key:
                             continue
                         info = self.blocking.get(callee.key)
                         if info is not None and fn.key not in self.blocking:
@@ -318,41 +345,58 @@ class DonationEnv:
         self._compute_attr_factories()
 
     def _compute_factories(self) -> None:
+        # per-function return facts derived in ONE AST walk: either donation
+        # positions knowable directly (literal/configured donate_argnums, a
+        # returned jit binding) or the resolved callee keys whose factory
+        # status the fixpoint below inherits ("return another_factory(...)").
+        # The fixpoint then iterates over these small fact lists instead of
+        # re-walking every function body per sweep.
+        pending: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        for idx in self.graph.indexes:
+            for fn in idx.functions.values():
+                direct: Tuple[int, ...] = ()
+                callees: List[Tuple[str, str]] = []
+                for node in own_nodes(fn.node):
+                    if not isinstance(node, ast.Return) or node.value is None:
+                        continue
+                    value = node.value
+                    if isinstance(value, ast.Call):
+                        donate = ModuleIndex.donate_info(value)
+                        if donate:
+                            direct = donate
+                            break
+                        if ModuleIndex.donate_configured(value):
+                            direct = CONFIGURED_DONATION
+                            break
+                        # return another_factory(...): inherit its positions
+                        callee = self._resolve_value_call(value, idx, fn)
+                        if callee is not None:
+                            callees.append(callee.key)
+                    elif isinstance(value, ast.Name):
+                        # return jitted — where ``jitted = jax.jit(..., donate_...)``
+                        binding = idx.jit_bindings.get(value.id)
+                        if binding is not None and binding.donate_argnums:
+                            direct = binding.donate_argnums
+                            break
+                        if binding is not None and binding.donate_configured:
+                            direct = CONFIGURED_DONATION
+                            break
+                if direct:
+                    self.factory_positions[fn.key] = direct
+                elif callees:
+                    pending[fn.key] = callees
         changed = True
         while changed:
             changed = False
-            for idx in self.graph.indexes:
-                for fn in idx.functions.values():
-                    if fn.key in self.factory_positions:
-                        continue
-                    pos = self._returned_donation(fn, idx)
+            for key, callees in pending.items():
+                if key in self.factory_positions:
+                    continue
+                for ck in callees:
+                    pos = self.factory_positions.get(ck)
                     if pos:
-                        self.factory_positions[fn.key] = pos
+                        self.factory_positions[key] = pos
                         changed = True
-
-    def _returned_donation(self, fn: FunctionInfo, idx: ModuleIndex) -> Tuple[int, ...]:
-        for node in own_nodes(fn.node):
-            if not isinstance(node, ast.Return) or node.value is None:
-                continue
-            value = node.value
-            if isinstance(value, ast.Call):
-                donate = ModuleIndex.donate_info(value)
-                if donate:
-                    return donate
-                if ModuleIndex.donate_configured(value):
-                    return CONFIGURED_DONATION
-                # return another_factory(...): inherit its positions
-                callee = self._resolve_value_call(value, idx, fn)
-                if callee is not None and callee.key in self.factory_positions:
-                    return self.factory_positions[callee.key]
-            elif isinstance(value, ast.Name):
-                # return jitted  — where ``jitted = jax.jit(..., donate_...)``
-                binding = idx.jit_bindings.get(value.id)
-                if binding is not None and binding.donate_argnums:
-                    return binding.donate_argnums
-                if binding is not None and binding.donate_configured:
-                    return CONFIGURED_DONATION
-        return ()
+                        break
 
     def _resolve_value_call(
         self, call: ast.Call, idx: ModuleIndex, fn: FunctionInfo

@@ -87,7 +87,7 @@ from unionml_tpu.analysis.cfg import (
     reachable,
 )
 from unionml_tpu.analysis.core import Finding, Project, register
-from unionml_tpu.analysis.dataflow import _call_map, own_nodes
+from unionml_tpu.analysis.dataflow import _call_map, own_nodes, resolved_edges
 
 #: interprocedural summary chains stop growing past this depth (mirrors
 #: dataflow.Summaries — deep chains stop being actionable witnesses)
@@ -585,6 +585,9 @@ class ResourceSummaries:
         self.callers_by_leaf: Dict[str, Set[str]] = {}
         #: (relpath, line, message) annotation hygiene problems
         self.hygiene: List[Tuple[str, int, str]] = []
+        #: fn key -> resolved callees of ``return f(...)`` statements — walked
+        #: once here so the fixpoint never re-walks function bodies
+        self._ret_call_callees: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
         self._collect_annotations()
         self._collect_direct()
         self._fixpoint()
@@ -655,9 +658,15 @@ class ResourceSummaries:
             rel: Set[str] = set()
             acq: List[Tuple[str, str]] = []  # (class, key)
             returns: List[ast.AST] = []
+            ret_callees: List[Tuple[str, str]] = []
             for node in own_nodes(fn.node):
                 if isinstance(node, ast.Return) and node.value is not None:
                     returns.append(node.value)
+                    if isinstance(node.value, ast.Call):
+                        cands = _call_map(fn).get(id(node.value))
+                        callee = self.graph._resolve(cands) if cands else None
+                        if callee is not None and callee is not fn:
+                            ret_callees.append(callee.key)
                 if not isinstance(node, ast.Call):
                     continue
                 leaf, recv = _leaf_and_recv(node)
@@ -690,6 +699,8 @@ class ResourceSummaries:
                 self.releases.setdefault(fn.key, {}).setdefault(
                     cls, (fn.qualname + " (# owns contract)",)
                 )
+            if ret_callees:
+                self._ret_call_callees[fn.key] = ret_callees
 
     # -- propagation ------------------------------------------------------
 
@@ -698,24 +709,16 @@ class ResourceSummaries:
         while changed:
             changed = False
             for fn in self.graph.by_key.values():
-                for candidates, call in fn.calls:
-                    callee = self.graph._resolve(candidates)
-                    if callee is None or callee is fn:
+                for callee, call in resolved_edges(self.graph, fn):
+                    if callee is fn:
                         continue
                     for cls, chain in self.releases.get(callee.key, {}).items():
                         mine = self.releases.setdefault(fn.key, {})
                         if cls not in mine and len(chain) < _MAX_CHAIN:
                             mine[cls] = (fn.qualname,) + chain
                             changed = True
-                for node in own_nodes(fn.node):
-                    if not (isinstance(node, ast.Return) and
-                            isinstance(node.value, ast.Call)):
-                        continue
-                    cands = _call_map(fn).get(id(node.value))
-                    callee = self.graph._resolve(cands) if cands else None
-                    if callee is None or callee is fn:
-                        continue
-                    inherited = self.acquires_ret.get(callee.key)
+                for callee_key in self._ret_call_callees.get(fn.key, ()):
+                    inherited = self.acquires_ret.get(callee_key)
                     if inherited:
                         mine = self.acquires_ret.setdefault(fn.key, set())
                         if not inherited <= mine:
@@ -731,9 +734,8 @@ class ResourceSummaries:
         claim under test.)"""
         if cls in self.direct_releases.get(fn.key, ()):
             return True
-        for candidates, _call in fn.calls:
-            callee = self.graph._resolve(candidates)
-            if callee is None or callee is fn:
+        for callee, _call in resolved_edges(self.graph, fn):
+            if callee is fn:
                 continue
             if cls in self.releases.get(callee.key, {}):
                 return True

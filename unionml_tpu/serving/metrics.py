@@ -231,7 +231,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         #: the one LEAF lock guarding all metric state (see module docstring)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-leaf
         #: name -> metric family; guarded-by: _lock
         self._metrics: Dict[str, _Metric] = {}
 

@@ -77,7 +77,11 @@ class ResidentPredictor:
         self._warmup = warmup
         self._compiled = None
         self._device_model_object = None
-        self._ready = False
+        # serializes setup(): predict() runs on executor threads, and several
+        # first requests can race into the lazy init — exactly one may compile
+        # and commit the artifact to device (the rest wait, then see _ready)
+        self._setup_lock = threading.Lock()
+        self._ready = False  # guarded-by: _setup_lock
         # per-request device-side latency (dispatch + device->host fetch), ms —
         # the server-side half of the device/HTTP latency split (VERDICT r3 #8):
         # /stats quotes these so tunnel/client RTT never masquerades as model time.
@@ -105,36 +109,44 @@ class ResidentPredictor:
         }
 
     def setup(self) -> None:
-        """Decide the execution mode and (if traceable) compile + warm the predictor."""
-        artifact = self._model.artifact
-        if artifact is None:
-            raise RuntimeError("ResidentPredictor.setup requires a loaded model artifact.")
+        """Decide the execution mode and (if traceable) compile + warm the predictor.
 
-        predictor = self._model._predictor
-        model_object = artifact.model_object
-        if is_jax_compatible(model_object):
-            predictor_fn = getattr(predictor, "fn", predictor)
-            if self._mesh is not None:
-                # mesh-resident artifact: parameters commit to every mesh device
-                # once (sharded per param_specs, else replicated); the compiled
-                # predictor then runs tensor/data-parallel across the mesh
-                from unionml_tpu.parallel.mesh import named_sharding_tree, replicated
+        Idempotent and thread-safe: concurrent first requests race through
+        predict()'s fast-path readiness check, so the body runs under
+        ``_setup_lock`` and re-checks — exactly one caller compiles and
+        commits the artifact to device; the rest block until it is ready."""
+        with self._setup_lock:
+            if self._ready:
+                return
+            artifact = self._model.artifact
+            if artifact is None:
+                raise RuntimeError("ResidentPredictor.setup requires a loaded model artifact.")
 
-                shardings = (
-                    named_sharding_tree(self._mesh, self._param_specs)
-                    if self._param_specs is not None
-                    else replicated(self._mesh)
-                )
-                self._device_model_object = jax.device_put(model_object, shardings)
+            predictor = self._model._predictor
+            model_object = artifact.model_object
+            if is_jax_compatible(model_object):
+                predictor_fn = getattr(predictor, "fn", predictor)
+                if self._mesh is not None:
+                    # mesh-resident artifact: parameters commit to every mesh device
+                    # once (sharded per param_specs, else replicated); the compiled
+                    # predictor then runs tensor/data-parallel across the mesh
+                    from unionml_tpu.parallel.mesh import named_sharding_tree, replicated
+
+                    shardings = (
+                        named_sharding_tree(self._mesh, self._param_specs)
+                        if self._param_specs is not None
+                        else replicated(self._mesh)
+                    )
+                    self._device_model_object = jax.device_put(model_object, shardings)  # graftlint: disable=data-race -- published once under _setup_lock; readers run only after the _ready check, which happens-after this write
+                else:
+                    # keep the artifact resident on device: no host->device transfer per request
+                    self._device_model_object = jax.tree_util.tree_map(jax.numpy.asarray, model_object)  # graftlint: disable=data-race -- published once under _setup_lock; readers run only after the _ready check, which happens-after this write
+                self._compiled = jax.jit(predictor_fn)  # graftlint: disable=data-race -- published once under _setup_lock; readers run only after the _ready check, which happens-after this write
+                if self._warmup:
+                    self._warm()  # graftlint: disable=lock-order -- one-time init: racing first requests MUST wait for compile+warm before serving, so blocking under _setup_lock is the contract
             else:
-                # keep the artifact resident on device: no host->device transfer per request
-                self._device_model_object = jax.tree_util.tree_map(jax.numpy.asarray, model_object)
-            self._compiled = jax.jit(predictor_fn)
-            if self._warmup:
-                self._warm()
-        else:
-            logger.info("Model object is not a jax pytree; serving will run the predictor eagerly.")
-        self._ready = True
+                logger.info("Model object is not a jax pytree; serving will run the predictor eagerly.")
+            self._ready = True
 
     def _warm(self) -> None:
         """Compile the smallest bucket ahead of the first request."""
@@ -253,7 +265,7 @@ class ResidentPredictor:
 
     def predict(self, features: Any = None, **reader_kwargs) -> Any:
         """Request-path prediction; uses the resident executable when possible."""
-        if not self._ready:
+        if not self._ready:  # graftlint: disable=data-race -- benign double-checked fast path; setup() re-checks under _setup_lock before doing any work
             self.setup()
         if self._compiled is None or features is None:
             return self._model.predict(features=features, **reader_kwargs)

@@ -354,7 +354,7 @@ class SLOScheduler:
         (that would turn the bound into a shove-the-queue race)."""
         if self.config.fifo:
             return None
-        candidates = [t for t in self._queued if t.resume is None]
+        candidates = [t for t in self._queued if t.resume is None]  # graftlint: disable=data-race -- submit() is the only caller and already holds _lock
         if not candidates:
             return None
         worst = max(candidates, key=lambda t: self._order_key(t, now))

@@ -101,7 +101,7 @@ class EngineSupervisor:
         # append-only before traffic starts, so reads need no lock
         self._subscribers: List[Callable[[str, str], None]] = []
 
-    def subscribe(self, callback: Callable[[str, str], None]) -> None:
+    def subscribe(self, callback: Callable[[str, str], None]) -> None:  # fires-outside-lock
         """Register ``callback(old_state, new_state)``, fired on every health
         transition — OUTSIDE the supervisor lock, so a subscriber may read
         supervisor state (or take its own locks) without deadlock. Callbacks
@@ -109,7 +109,7 @@ class EngineSupervisor:
         must be cheap and exception-safe; an exception is logged and dropped.
         Subscribe before attaching traffic: registration is not synchronized
         against concurrent transitions."""
-        self._subscribers.append(callback)
+        self._subscribers.append(callback)  # graftlint: disable=data-race -- documented contract (see docstring): append-only before traffic starts; _notify iterates a list() snapshot
 
     def _notify(self, old: str, new: str) -> None:
         # called OUTSIDE _lock by design (see subscribe) — a subscriber that
@@ -265,7 +265,7 @@ class EngineSupervisor:
     def attach(self, engine: Any) -> None:
         """Bind the supervised engine and start the watchdog thread (when
         ``watchdog_interval_s`` > 0). Called by the owning batcher."""
-        self._engine = engine
+        self._engine = engine  # graftlint: disable=data-race -- attach() runs once at construction; Thread.start() below orders this write before every _watch read
         if self.watchdog_interval_s > 0 and self._watchdog is None:
             self._watchdog = threading.Thread(
                 target=self._watch, name="engine-watchdog", daemon=True

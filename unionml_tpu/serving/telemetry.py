@@ -183,14 +183,14 @@ class Telemetry:
         self.slo = slo if slo is not None else SLOTracker()
         self._max_spans = int(max_spans)
         #: guards _active/_ring/_completed; LEAF (never calls out — see module doc)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-leaf
         self._active: Dict[str, Trace] = {}  # guarded-by: _lock
         self._ring: Deque[Trace] = deque(maxlen=int(journal_size))  # guarded-by: _lock
         self._completed = 0  # guarded-by: _lock
         self._dropped_spans = 0  # guarded-by: _lock
         self.journal_path = journal_path
         #: serializes JSONL appends only; LEAF, never held with _lock
-        self._journal_lock = threading.Lock()
+        self._journal_lock = threading.Lock()  # lock-leaf
 
         m = self.metrics
         self.requests_total = m.counter(
